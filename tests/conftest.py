@@ -1,0 +1,24 @@
+"""Test harness config: force an 8-device CPU platform before JAX initialises.
+
+This is the standard JAX trick for testing distributed code without a cluster
+(SURVEY.md §4): ``xla_force_host_platform_device_count=8`` gives 8 virtual CPU
+devices, so ``shard_map`` tree merges run exactly the collective program they
+would run on an 8-chip TPU slice.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU feature-parity with TPU numerics tests deterministic.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The env var alone can be overridden by platform plugins (the axon TPU plugin
+# in this image); the explicit config update always wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,76 @@
+"""Torch SDPA oracle helpers for numerics tests.
+
+The reference's own numerics cannot be the oracle (its local path contracts
+over the head axis and its distributed path crashes; SURVEY.md §2.1), so the
+fidelity contract of this framework is "matches torch scaled_dot_product
+attention" (BASELINE.json config 2). fp32 throughout for a tight bound.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+
+def _causal_bool_mask(Tq: int, Tk: int, q_offset: int | None) -> torch.Tensor:
+    """Bottom-right-aligned causal mask unless q_offset overrides.
+
+    Query row i has global position ``q_offset + i``; it sees key j iff
+    ``q_offset + i >= j``. Default ``q_offset = Tk - Tq`` (flash-attention /
+    decode convention: the last query is the last position).
+    """
+    if q_offset is None:
+        q_offset = Tk - Tq
+    qpos = torch.arange(Tq).unsqueeze(1) + q_offset
+    kpos = torch.arange(Tk).unsqueeze(0)
+    return qpos >= kpos
+
+
+def sdpa_out_lse(
+    q: np.ndarray,
+    k: np.ndarray,
+    v: np.ndarray,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    q_offset: int | None = None,
+):
+    """Return (out, lse) from torch, shapes (B, H, Tq, D) / (B, H, Tq)."""
+    tq = torch.from_numpy(np.asarray(q, np.float32))
+    tk = torch.from_numpy(np.asarray(k, np.float32))
+    tv = torch.from_numpy(np.asarray(v, np.float32))
+    Hq, Hkv = tq.shape[1], tk.shape[1]
+    if Hq != Hkv:
+        tk = tk.repeat_interleave(Hq // Hkv, dim=1)
+        tv = tv.repeat_interleave(Hq // Hkv, dim=1)
+    s = (tq.shape[-1] ** -0.5) if scale is None else scale
+    mask = None
+    if causal:
+        mask = _causal_bool_mask(tq.shape[2], tk.shape[2], q_offset)
+    out = F.scaled_dot_product_attention(tq, tk, tv, attn_mask=mask, scale=s)
+    logits = torch.matmul(tq, tk.transpose(-2, -1)) * s
+    if causal:
+        logits = logits.masked_fill(~mask, float("-inf"))
+    lse = torch.logsumexp(logits, dim=-1)
+    return out.numpy(), lse.numpy()
+
+
+def sdpa_grads(q, k, v, dout, *, causal=False, scale=None, q_offset=None):
+    """Gradients of sum(out * dout) wrt q, k, v via torch autograd."""
+    tq = torch.from_numpy(np.asarray(q, np.float32)).requires_grad_(True)
+    tk = torch.from_numpy(np.asarray(k, np.float32)).requires_grad_(True)
+    tv = torch.from_numpy(np.asarray(v, np.float32)).requires_grad_(True)
+    Hq, Hkv = tq.shape[1], tk.shape[1]
+    ek, ev = tk, tv
+    if Hq != Hkv:
+        ek = tk.repeat_interleave(Hq // Hkv, dim=1)
+        ev = tv.repeat_interleave(Hq // Hkv, dim=1)
+    s = (tq.shape[-1] ** -0.5) if scale is None else scale
+    mask = None
+    if causal:
+        mask = _causal_bool_mask(tq.shape[2], ek.shape[2], q_offset)
+    out = F.scaled_dot_product_attention(tq, ek, ev, attn_mask=mask, scale=s)
+    loss = (out * torch.from_numpy(np.asarray(dout, np.float32))).sum()
+    loss.backward()
+    return tq.grad.numpy(), tk.grad.numpy(), tv.grad.numpy()

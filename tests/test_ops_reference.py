@@ -1,0 +1,143 @@
+"""Unit tests: jnp reference attention vs torch SDPA oracle (BASELINE config 1/2).
+
+Covers causal and non-causal, GQA ratios, offsets, fully-masked rows, the
+blockwise == naive equivalence, and the merge-partials monoid — the numerics
+anchor everything else (Pallas kernels, tree merge) is tested against.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tree_attention_tpu.ops import (
+    attention_blockwise,
+    attention_naive,
+    flash_attention,
+    merge_partials,
+)
+from tests.oracles import sdpa_out_lse
+
+
+def make_qkv(rng, B=2, Hq=4, Hkv=4, Tq=64, Tk=64, D=32, dtype=np.float32):
+    q = rng.standard_normal((B, Hq, Tq, D), np.float32).astype(dtype)
+    k = rng.standard_normal((B, Hkv, Tk, D), np.float32).astype(dtype)
+    v = rng.standard_normal((B, Hkv, Tk, D), np.float32).astype(dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["naive", "blockwise"])
+def test_matches_torch_sdpa(causal, impl):
+    rng = np.random.default_rng(0)
+    q, k, v = make_qkv(rng, Tq=96, Tk=96)
+    out, lse = flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal, impl=impl
+    )
+    ref_out, ref_lse = sdpa_out_lse(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref_out, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2), (8, 1)])
+def test_gqa_ratios(hq, hkv):
+    rng = np.random.default_rng(1)
+    q, k, v = make_qkv(rng, Hq=hq, Hkv=hkv, Tq=32, Tk=80)
+    # Bottom-right causal alignment: the last query is the last position.
+    out, lse = flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
+        impl="blockwise", q_offset=80 - 32,
+    )
+    ref_out, ref_lse = sdpa_out_lse(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref_out, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=2e-5, rtol=2e-5)
+
+
+def test_decode_shape_q1():
+    """The reference's headline workload: single-query decode (model.py:51)."""
+    rng = np.random.default_rng(2)
+    q, k, v = make_qkv(rng, B=1, Hq=16, Hkv=16, Tq=1, Tk=1024, D=128)
+    out, lse = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), impl="blockwise")
+    ref_out, ref_lse = sdpa_out_lse(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), ref_out, atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("blk", [16, 33, 512])
+def test_blockwise_matches_naive_ragged_blocks(blk):
+    rng = np.random.default_rng(3)
+    q, k, v = make_qkv(rng, Tq=40, Tk=100)
+    o1, l1 = attention_naive(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+    o2, l2 = attention_blockwise(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True, block_size=blk
+    )
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5, rtol=1e-5)
+
+
+def test_offsets_express_sharded_causality():
+    """Shard KV in two, use kv_offset for the second shard, merge == full."""
+    rng = np.random.default_rng(4)
+    q, k, v = make_qkv(rng, Tq=64, Tk=64)
+    qj, kj, vj = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    full_out, full_lse = attention_naive(qj, kj, vj, causal=True)
+
+    half = 32
+    parts = []
+    for i in range(2):
+        o, l = attention_naive(
+            qj, kj[:, :, i * half:(i + 1) * half], vj[:, :, i * half:(i + 1) * half],
+            causal=True, kv_offset=i * half,
+        )
+        parts.append((o, l))
+    out, lse = merge_partials(
+        jnp.stack([p[0] for p in parts]), jnp.stack([p[1] for p in parts])
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full_out), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(full_lse), atol=1e-5, rtol=1e-5)
+
+
+def test_fully_masked_rows_are_zero_with_neginf_lse():
+    """A KV shard strictly in the causal future contributes the monoid identity."""
+    rng = np.random.default_rng(5)
+    q, k, v = make_qkv(rng, Tq=8, Tk=16)
+    out, lse = attention_naive(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True, kv_offset=1000
+    )
+    assert np.all(np.asarray(out) == 0.0)
+    assert np.all(np.isneginf(np.asarray(lse)))
+    # And merging it with a real shard changes nothing.
+    o_real, l_real = attention_naive(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+    o_m, l_m = merge_partials(jnp.stack([o_real, out]), jnp.stack([l_real, lse]))
+    np.testing.assert_allclose(np.asarray(o_m), np.asarray(o_real), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l_m), np.asarray(l_real), atol=1e-6)
+
+
+def test_bf16_inputs_fp32_lse():
+    rng = np.random.default_rng(6)
+    q, k, v = make_qkv(rng, Tq=32, Tk=64)
+    qb = jnp.asarray(q, jnp.bfloat16)
+    kb = jnp.asarray(k, jnp.bfloat16)
+    vb = jnp.asarray(v, jnp.bfloat16)
+    out, lse = flash_attention(qb, kb, vb, causal=True, impl="blockwise", q_offset=64 - 32)
+    assert out.dtype == jnp.bfloat16
+    assert lse.dtype == jnp.float32
+    ref_out, ref_lse = sdpa_out_lse(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref_out, atol=5e-2, rtol=5e-2)
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=5e-2, rtol=5e-2)
+
+
+def test_merge_partials_associative_many_shards():
+    rng = np.random.default_rng(7)
+    q, k, v = make_qkv(rng, Tq=16, Tk=128)
+    qj, kj, vj = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    full_out, full_lse = attention_naive(qj, kj, vj)
+    S, blk = 8, 16
+    outs, lses = [], []
+    for i in range(S):
+        o, l = attention_naive(qj, kj[:, :, i * blk:(i + 1) * blk], vj[:, :, i * blk:(i + 1) * blk])
+        outs.append(o)
+        lses.append(l)
+    out, lse = merge_partials(jnp.stack(outs), jnp.stack(lses))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full_out), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(full_lse), atol=1e-5, rtol=1e-5)

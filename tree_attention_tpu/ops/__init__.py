@@ -1,0 +1,100 @@
+"""Attention ops: one contract, several implementations.
+
+``flash_attention(q, k, v, ...) -> (out, lse)`` is the framework-wide kernel
+contract (the reference's ``flash_res_lse``, ``/root/reference/model.py:60-83``,
+done right). Implementations:
+
+- ``"naive"``     — materialised scores, test oracle (:mod:`.reference`)
+- ``"blockwise"`` — online-softmax ``lax.scan``, any backend (:mod:`.reference`)
+- ``"pallas"``    — Pallas TPU kernel, fwd+bwd (:mod:`.pallas_attention`)
+- ``"auto"``      — pallas on TPU, blockwise elsewhere
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from tree_attention_tpu.ops.reference import (  # noqa: F401
+    attention_blockwise,
+    attention_naive,
+    finalize,
+    merge_partials,
+)
+
+_IMPLS = ("auto", "naive", "blockwise", "pallas")
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # no backends initialised
+        return False
+
+
+def _pallas_available() -> bool:
+    try:
+        import tree_attention_tpu.ops.pallas_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    impl: str = "auto",
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Compute attention over the sequence axis, returning ``(out, lse)``.
+
+    Args:
+      q: ``(B, Hq, Tq, D)`` queries.
+      k, v: ``(B, Hkv, Tk, D)`` keys/values; ``Hq % Hkv == 0`` (GQA).
+      causal: apply causal masking (``-inf`` before softmax).
+      scale: logit scale; default ``D**-0.5``.
+      q_offset / kv_offset: global positions of the first local query/key row,
+        for causal masking across sequence shards.
+      impl: ``auto | naive | blockwise | pallas``.
+      block_size: KV block length for the blockwise/pallas paths.
+
+    Returns:
+      ``out``: ``(B, Hq, Tq, D)`` in q's dtype; ``lse``: ``(B, Hq, Tq)``
+      float32 logsumexp of the scaled logits (the merge currency of the tree
+      reduction).
+    """
+    if impl not in _IMPLS:
+        raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
+    if impl == "auto":
+        if _on_tpu() and _pallas_available():
+            impl = "pallas"
+        else:
+            impl = "blockwise"
+    if impl == "naive":
+        return attention_naive(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset, kv_offset=kv_offset
+        )
+    if impl == "blockwise":
+        return attention_blockwise(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            kv_offset=kv_offset, block_size=block_size,
+        )
+    try:
+        from tree_attention_tpu.ops.pallas_attention import attention_pallas
+    except ImportError as e:
+        raise NotImplementedError(
+            "impl='pallas' requested but the Pallas kernel module is not "
+            "available in this build; use impl='blockwise' or 'auto'"
+        ) from e
+
+    return attention_pallas(
+        q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+        kv_offset=kv_offset, block_size=block_size,
+    )

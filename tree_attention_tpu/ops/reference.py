@@ -1,0 +1,244 @@
+"""Pure-jnp reference attention kernels emitting ``(out, lse)``.
+
+These are the numerics anchor of the framework and the CPU fallback path. The
+kernel contract — every attention impl returns the attention output *and* the
+logsumexp of the scaled logits per query row — is the spine of the tree merge,
+mirroring the reference's ``flash_res_lse`` (``/root/reference/model.py:60-83``)
+but fixing its three confirmed bugs:
+
+1. The contraction runs over the *sequence* axis (the reference's layout
+   mismatch made it attend over the head axis, ``model.py:74`` with
+   ``model.py:51-53`` layouts).
+2. ``lse`` is the logsumexp of the **scaled logits**, not of post-softmax
+   probabilities (``model.py:80``), which is what the safe-softmax merge
+   requires.
+3. Causal masking uses ``-inf`` before the softmax, not ``tril`` zeroing
+   (``model.py:76``), and supports cross-shard offsets so a sequence-sharded
+   KV block knows its global position.
+
+Two implementations share one contract:
+
+- :func:`attention_naive` — materialises the score matrix; the readable
+  oracle for tests (small shapes only).
+- :func:`attention_blockwise` — ``lax.scan`` over KV blocks with an online
+  softmax (running max / sum / accumulator), O(block) memory; the
+  any-backend fallback with the same access pattern as the Pallas kernel.
+
+Shapes (TPU-friendly, head-major so the trailing two dims tile onto the MXU):
+
+- ``q``: ``(B, Hq, Tq, D)``
+- ``k``, ``v``: ``(B, Hkv, Tk, D)`` with ``Hq % Hkv == 0`` (GQA/MQA)
+- returns ``out``: ``(B, Hq, Tq, D)`` (q's dtype), ``lse``: ``(B, Hq, Tq)``
+  float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = float("-inf")
+
+
+def _expand_gqa(k: jax.Array, v: jax.Array, num_q_heads: int) -> Tuple[jax.Array, jax.Array]:
+    """Repeat KV heads up to the query head count for grouped-query attention."""
+    num_kv_heads = k.shape[1]
+    if num_kv_heads == num_q_heads:
+        return k, v
+    if num_q_heads % num_kv_heads != 0:
+        raise ValueError(
+            f"query heads ({num_q_heads}) must be a multiple of kv heads ({num_kv_heads})"
+        )
+    group = num_q_heads // num_kv_heads
+    return (
+        jnp.repeat(k, group, axis=1),
+        jnp.repeat(v, group, axis=1),
+    )
+
+
+def _default_scale(head_dim: int, scale: Optional[float]) -> float:
+    return (head_dim ** -0.5) if scale is None else scale
+
+
+def _causal_mask(
+    q_len: int, k_len: int, q_offset, k_offset
+) -> jax.Array:
+    """Visibility mask: query at global position i sees key at global j iff i >= j.
+
+    ``q_offset``/``k_offset`` are the global positions of the first local
+    query/key row — this is how a sequence-sharded KV block expresses causality
+    against replicated or sharded Q (the reference never faced this: its causal
+    path is dead code, ``model.py:100``).
+    """
+    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
+    k_pos = k_offset + lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
+    return q_pos >= k_pos
+
+
+def finalize(out_unnormalized: jax.Array, m: jax.Array, l: jax.Array, out_dtype) -> Tuple[jax.Array, jax.Array]:
+    """Turn running (acc, max, sum) online-softmax state into (out, lse).
+
+    Rows that saw no visible key (``m == -inf`` / ``l == 0``) produce zero
+    output and ``lse == -inf`` so a later :func:`merge_partials` treats the
+    shard as contributing nothing — the identity of the safe-softmax monoid.
+    """
+    empty = l <= 0.0
+    safe_l = jnp.where(empty, 1.0, l)
+    out = out_unnormalized / safe_l[..., None]
+    out = jnp.where(empty[..., None], 0.0, out)
+    lse = jnp.where(empty, NEG_INF, m + jnp.log(safe_l))
+    return out.astype(out_dtype), lse.astype(jnp.float32)
+
+
+def attention_naive(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Materialised-scores attention. Oracle implementation for tests."""
+    B, Hq, Tq, D = q.shape
+    k, v = _expand_gqa(k, v, Hq)
+    Tk = k.shape[2]
+    s = _default_scale(D, scale)
+
+    if Tk == 0:  # empty shard contributes the safe-softmax identity
+        return (
+            jnp.zeros_like(q),
+            jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),
+        )
+
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * s
+    if causal:
+        mask = _causal_mask(Tq, Tk, q_offset, kv_offset)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+
+    m = jnp.max(logits, axis=-1)
+    # exp(-inf - -inf) would be nan; fully-masked rows get m := 0 so that
+    # exp(-inf - 0) = 0 and the row drops out cleanly.
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return finalize(acc, m, l, q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_size"))
+def attention_blockwise(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Online-softmax attention: ``lax.scan`` over KV blocks, O(block) memory.
+
+    Same math the Pallas kernel performs on-chip; usable on any backend. This
+    is what the reference's ``flash_res_lse`` *claims* to be ("simulates flash
+    attention", ``model.py:62``) but isn't — it materialises the full score
+    matrix.
+
+    GQA runs against *unexpanded* KV: query heads are folded into a group axis
+    (``bghqd,bhkd->bghqk``) so KV memory stays ``Hkv``-sized — the point of
+    grouped-query attention for big KV caches.
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv = k.shape[1]
+    if Hq % Hkv != 0:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    Tk = k.shape[2]
+    s = _default_scale(D, scale)
+
+    if Tk == 0:  # empty shard contributes the safe-softmax identity
+        return (
+            jnp.zeros_like(q),
+            jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),
+        )
+
+    blk = min(block_size, Tk)
+    num_blocks = (Tk + blk - 1) // blk
+    pad = num_blocks * blk - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+    qf = (q.astype(jnp.float32) * s).reshape(B, Hkv, G, Tq, D)
+    # (num_blocks, B, Hkv, blk, D) scan layout
+    kb = k.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+
+    m0 = jnp.full((B, Hkv, G, Tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, Tq), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, G, Tq, D), jnp.float32)
+
+    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 0)
+
+    def body(carry, inputs):
+        m_prev, l_prev, acc = carry
+        blk_idx, k_blk, v_blk = inputs
+        logits = jnp.einsum(
+            "bhgqd,bhkd->bhgqk", qf, k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        start = blk_idx * blk
+        k_pos = start + kv_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)
+        valid = (start + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)) < Tk
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        logits = jnp.where(valid[None, None, None], logits, NEG_INF)
+
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m_prev), NEG_INF, m_prev - m_safe))
+        p = jnp.exp(logits - m_safe[..., None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhgqk,bhkd->bhgqd", p, v_blk.astype(jnp.float32)
+        )
+        return (m_new, l_new, acc_new), None
+
+    idxs = jnp.arange(num_blocks)
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0), (idxs, kb, vb))
+    out, lse = finalize(acc, m, l, q.dtype)
+    return out.reshape(B, Hq, Tq, D), lse.reshape(B, Hq, Tq)
+
+
+def merge_partials(outs: jax.Array, lses: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Merge per-shard ``(out, lse)`` partials along a leading stacked axis.
+
+    The local-device form of the tree reduction: given ``outs`` of shape
+    ``(S, ..., D)`` and ``lses`` of shape ``(S, ...)`` from S KV shards,
+    recombine into the exact global softmax via the safe-softmax monoid:
+    ``m = max_i lse_i; num = Σ out_i · e^{lse_i − m}; den = Σ e^{lse_i − m}``.
+
+    This is what the reference's three allreduces compute across ranks
+    (``model.py:108,114-115``) — here as a pure function, reusable both in
+    tests and inside the split-KV decode kernel.
+    """
+    m = jnp.max(lses, axis=0)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w = jnp.exp(lses - m_safe[None])
+    den = jnp.sum(w, axis=0)
+    num = jnp.sum(outs.astype(jnp.float32) * w[..., None], axis=0)
+    empty = den <= 0.0
+    out = jnp.where(empty[..., None], 0.0, num / jnp.where(empty, 1.0, den)[..., None])
+    lse = jnp.where(empty, NEG_INF, m + jnp.log(jnp.where(empty, 1.0, den)))
+    return out.astype(outs.dtype), lse.astype(jnp.float32)
