@@ -1,0 +1,13 @@
+"""Parallelism layer: mesh/runtime + sequence-parallel attention algorithms."""
+
+from tree_attention_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    cpu_mesh,
+    initialize_distributed,
+    make_mesh,
+    replicate,
+    shard_along,
+)
+from tree_attention_tpu.parallel.tree import tree_attention, tree_decode  # noqa: F401

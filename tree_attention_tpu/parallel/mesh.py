@@ -1,0 +1,116 @@
+"""Device mesh and distributed-runtime layer.
+
+TPU-native replacement for the reference's process/rendezvous machinery
+(``setup``/``cleanup``/``mp.spawn``, ``/root/reference/model.py:11-33,159-169``):
+instead of one OS process per device and an env-var NCCL rendezvous, JAX runs
+one process per *host*, every local device is addressed through a named
+:class:`jax.sharding.Mesh`, and collectives are compiled into the program by
+XLA (ICI within a slice, DCN across slices).
+
+The reference conflates "has accelerators" with "is distributed" (its setup is
+a silent no-op on CPU). Here backend selection and mesh topology are
+orthogonal: the same mesh code runs on a TPU pod, a single chip, or N virtual
+CPU devices (``xla_force_host_platform_device_count``) for cluster-free tests.
+
+Canonical axis names (SURVEY.md §2.4: the reference only ever has "seq"; the
+rest are the natural extension points):
+
+- ``data``  — batch/data parallelism
+- ``seq``   — sequence/context parallelism (the product)
+- ``model`` — tensor parallelism over heads
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap: the ``setup()`` equivalent (``model.py:11-23``).
+
+    On a single host this is a no-op (unlike the reference, which silently
+    skips initialisation whenever CUDA is missing). On a multi-host TPU slice
+    arguments are usually auto-detected from the TPU metadata server, so
+    calling with no arguments is correct there too.
+    """
+    if num_processes is not None and num_processes > 1 or coordinator_address:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def make_mesh(
+    axes: Optional[Mapping[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh; default: all devices on one ``seq`` axis.
+
+    ``axes`` maps axis name -> size, in major-to-minor order. An axis size of
+    -1 absorbs the remaining devices (like a reshape). Device order comes from
+    ``jax.make_mesh``'s ICI-topology-aware layout when running on real TPU
+    hardware, so the ``seq`` axis rides the torus.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if axes is None:
+        axes = {AXIS_SEQ: n}
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    n_fixed = int(np.prod([s for s in sizes if s != -1]))
+    if any(s == -1 for s in sizes):
+        if n % n_fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        sizes = [n // n_fixed if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {int(np.prod(sizes))} "
+            f"devices, have {n}"
+        )
+    if len(devices) == jax.device_count():
+        # Full-device meshes go through jax.make_mesh for its ICI-topology-
+        # aware device ordering; explicit subsets keep the caller's order.
+        try:
+            return jax.make_mesh(tuple(sizes), names, devices=tuple(devices))
+        except TypeError:  # older signature without devices kwarg
+            pass
+    mesh_devices = np.asarray(devices).reshape(tuple(sizes))
+    return Mesh(mesh_devices, names)
+
+
+def cpu_mesh(n: int, axes: Optional[Mapping[str, int]] = None) -> Mesh:
+    """Mesh over N virtual CPU devices — the cluster-free test topology."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices, have {len(cpus)}; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{n} before importing jax"
+        )
+    return make_mesh(axes or {AXIS_SEQ: n}, devices=cpus[:n])
+
+
+def shard_along(mesh: Mesh, x: jax.Array, axis_name: str, dim: int) -> jax.Array:
+    """Place ``x`` with dimension ``dim`` sharded over mesh axis ``axis_name``."""
+    spec = [None] * x.ndim
+    spec[dim] = axis_name
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(mesh: Mesh, x: jax.Array) -> jax.Array:
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,224 @@
+"""Sequence-parallel tree attention: the algorithm layer.
+
+TPU-native realisation of the reference's ``tree_decode``
+(``/root/reference/model.py:85-124``): each device holds a KV sequence shard,
+computes flash attention locally emitting ``(out, lse)``, and the partials are
+merged with a safe-softmax reduction across the mesh's ``seq`` axis. Where the
+reference issues three NCCL allreduces over tensors redundantly broadcast
+across the head dim (``model.py:108,114-115`` — a 128× payload inflation, see
+SURVEY.md §2.1), this build does **one** ``pmax`` over the per-row lse scalars
+and **one** ``psum`` over a packed ``[numerator | denominator]`` tensor; XLA
+lowers both to topology-aware ICI collectives, which is exactly the log-depth
+"tree" the algorithm's name refers to.
+
+Two entry points:
+
+- :func:`tree_decode` — the reference's shape: Q replicated (a few query
+  tokens, usually 1), KV sharded along sequence. Collective payload is
+  O(B·H·Tq·D) per device, independent of context length.
+- :func:`tree_attention` — the training shape the reference lacks
+  (BASELINE.json configs 2/5): Q, K, V all sequence-sharded. Q is
+  all-gathered over the seq axis, every device computes global-Q ×
+  local-KV flash attention, and the merge is a ``psum_scatter`` so each
+  device ends up with exactly its own Q rows — an all-reduce's bandwidth
+  halved, and fully differentiable.
+
+Both compose with data parallelism (batch dim) and tensor parallelism (head
+dim) via optional extra mesh axes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.ops.reference import NEG_INF
+from tree_attention_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _merge_across(
+    out: jax.Array, lse: jax.Array, axis_name: str
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """All-reduce form of the safe-softmax merge over a mesh axis.
+
+    Returns (num, den, m): caller normalises (or reduce-scatters first).
+    ``num``/``den`` are packed into a single psum so one collective carries
+    both — the decode step is collective-latency bound at pod scale
+    (SURVEY.md §7 hard part 5).
+    """
+    packed, m = _weigh_and_pack(out, lse, axis_name)
+    packed = lax.psum(packed, axis_name)
+    D = out.shape[-1]
+    return packed[..., :D], packed[..., D], m
+
+
+def _weigh_and_pack(
+    out: jax.Array, lse: jax.Array, axis_name: str
+) -> Tuple[jax.Array, jax.Array]:
+    """Rescale a shard's partial by exp(lse - global max) and pack [num | den].
+
+    The reduction over the packed tensor (psum for replicated-Q decode,
+    psum_scatter for sharded-Q training) is the only thing that differs
+    between the two tree paths. pmax has no differentiation rule, and none is
+    needed: the merged softmax is mathematically invariant to the stabilising
+    shift m, so its gradient contribution is identically zero.
+    """
+    m = lax.pmax(lax.stop_gradient(lse), axis_name)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w = jnp.exp(lse - m_safe)
+    packed = jnp.concatenate(
+        [out.astype(jnp.float32) * w[..., None], w[..., None]], axis=-1
+    )
+    return packed, m
+
+
+def _finalize_merge(num, den, m, out_dtype):
+    empty = den <= 0.0
+    den_safe = jnp.where(empty, 1.0, den)
+    out = jnp.where(empty[..., None], 0.0, num / den_safe[..., None])
+    lse = jnp.where(empty, NEG_INF, m + jnp.log(den_safe))
+    return out.astype(out_dtype), lse.astype(jnp.float32)
+
+
+def tree_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_position: Optional[int] = None,
+    impl: str = "auto",
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Replicated-Q, sequence-sharded-KV exact attention (the decode shape).
+
+    Args:
+      q: ``(B, Hq, Tq, D)``, replicated over ``seq_axis`` (Tq is typically 1).
+      k, v: ``(B, Hkv, Tk_global, D)`` sharded along dim 2 over ``seq_axis``.
+      q_position: global position of the first query row for causal masking;
+        defaults to ``Tk_global - Tq`` (queries are the newest tokens).
+      data_axis / head_axis: optional extra mesh axes sharding batch / heads.
+
+    Returns:
+      ``(out, lse)`` with q's sharding (replicated over ``seq_axis``).
+    """
+    Tk_global = k.shape[2]
+    Tq = q.shape[2]
+    if q_position is None:
+        q_position = Tk_global - Tq
+    n_shards = mesh.shape[seq_axis]
+    if Tk_global % n_shards:
+        raise ValueError(
+            f"global KV length {Tk_global} must divide over {n_shards} "
+            f"'{seq_axis}' shards"
+        )
+    Tk_local = Tk_global // n_shards
+
+    q_spec = P(data_axis, head_axis, None, None)
+    kv_spec = P(data_axis, head_axis, seq_axis, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=(q_spec, P(data_axis, head_axis, None)),
+        check_vma=False,
+    )
+    def _sharded(q_l, k_l, v_l):
+        shard = lax.axis_index(seq_axis)
+        out, lse = flash_attention(
+            q_l, k_l, v_l,
+            causal=causal, scale=scale,
+            q_offset=q_position,
+            kv_offset=shard * Tk_local,
+            impl=impl, block_size=block_size,
+        )
+        num, den, m = _merge_across(out, lse, seq_axis)
+        return _finalize_merge(num, den, m, q.dtype)
+
+    return _sharded(q, k, v)
+
+
+def tree_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_position: Optional[int] = None,
+    impl: str = "auto",
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fully sequence-sharded exact attention (the training shape).
+
+    Q, K and V are all sharded along the sequence dim over ``seq_axis``.
+    Device ``i`` all-gathers Q, computes flash attention of *global* Q against
+    its *local* KV shard (with block-causal offsets), and the packed
+    numerator/denominator is ``psum_scatter``-ed so device ``i`` receives the
+    exact softmax for its own Q rows. Differentiable end-to-end: the backward
+    of ``all_gather`` is ``psum_scatter`` and vice versa, so gradient
+    collectives mirror the forward automatically.
+
+    Returns:
+      ``(out, lse)`` sharded like ``q``.
+    """
+    B, Hq, Tq_global, D = q.shape
+    if q_position is None:
+        # Bottom-right causal alignment, same convention as tree_decode: the
+        # last query is the last key position (0 when Tq == Tk, the usual
+        # training case; chunked prefill passes Tq < Tk).
+        q_position = k.shape[2] - Tq_global
+    n_shards = mesh.shape[seq_axis]
+    if Tq_global % n_shards or k.shape[2] % n_shards:
+        raise ValueError(
+            f"sequence lengths (q={Tq_global}, k={k.shape[2]}) must divide "
+            f"over {n_shards} '{seq_axis}' shards"
+        )
+    Tq_local = Tq_global // n_shards
+    Tk_local = k.shape[2] // n_shards
+
+    spec = P(data_axis, head_axis, seq_axis, None)
+    lse_spec = P(data_axis, head_axis, seq_axis)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, lse_spec),
+        check_vma=False,
+    )
+    def _sharded(q_l, k_l, v_l):
+        shard = lax.axis_index(seq_axis)
+        q_glob = lax.all_gather(q_l, seq_axis, axis=2, tiled=True)
+        out, lse = flash_attention(
+            q_glob, k_l, v_l,
+            causal=causal, scale=scale,
+            q_offset=q_position,
+            kv_offset=shard * Tk_local,
+            impl=impl, block_size=block_size,
+        )
+        packed, m = _weigh_and_pack(out, lse, seq_axis)
+        packed = lax.psum_scatter(packed, seq_axis, scatter_dimension=2, tiled=True)
+        num, den = packed[..., :D], packed[..., D]
+        m_local = lax.dynamic_slice_in_dim(m, shard * Tq_local, Tq_local, axis=2)
+        return _finalize_merge(num, den, m_local, q.dtype)
+
+    return _sharded(q, k, v)
