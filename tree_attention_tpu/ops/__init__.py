@@ -52,6 +52,7 @@ def flash_attention(
     kv_offset=0,
     impl: str = "auto",
     block_size: int = 512,
+    custom_vjp: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Compute attention over the sequence axis, returning ``(out, lse)``.
 
@@ -64,6 +65,10 @@ def flash_attention(
         for causal masking across sequence shards.
       impl: ``auto | naive | blockwise | pallas``.
       block_size: KV block length for the blockwise/pallas paths.
+      custom_vjp: use the flash (recompute-from-lse) backward — O(T) residual
+        memory but **reverse-mode only** (``jax.jvp``/``jacfwd`` raise on
+        custom_vjp functions). Pass False (or ``impl='naive'``) for
+        forward-mode differentiability at O(T²) memory.
 
     Returns:
       ``out``: ``(B, Hq, Tq, D)`` in q's dtype; ``lse``: ``(B, Hq, Tq)``
@@ -78,23 +83,27 @@ def flash_attention(
         else:
             impl = "blockwise"
     if impl == "naive":
+        # Raw autodiff path: the differential oracle the custom VJP is
+        # tested against.
         return attention_naive(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset, kv_offset=kv_offset
         )
-    if impl == "blockwise":
+    if impl == "pallas":
+        try:
+            import tree_attention_tpu.ops.pallas_attention  # noqa: F401
+        except ImportError as e:
+            raise NotImplementedError(
+                "impl='pallas' requested but the Pallas kernel module is not "
+                "available in this build; use impl='blockwise' or 'auto'"
+            ) from e
+    if not custom_vjp and impl == "blockwise":
         return attention_blockwise(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset,
             kv_offset=kv_offset, block_size=block_size,
         )
-    try:
-        from tree_attention_tpu.ops.pallas_attention import attention_pallas
-    except ImportError as e:
-        raise NotImplementedError(
-            "impl='pallas' requested but the Pallas kernel module is not "
-            "available in this build; use impl='blockwise' or 'auto'"
-        ) from e
+    from tree_attention_tpu.ops.vjp import flash_attention_vjp
 
-    return attention_pallas(
+    return flash_attention_vjp(
         q, k, v, causal=causal, scale=scale, q_offset=q_offset,
-        kv_offset=kv_offset, block_size=block_size,
+        kv_offset=kv_offset, impl=impl, block_size=block_size,
     )

@@ -1,0 +1,207 @@
+"""Flash-attention backward: custom VJP with O(T) residual memory.
+
+Autodiff through the blockwise forward would store every block's probability
+matrix (O(T²) across the scan). The flash recipe instead saves only
+``(q, k, v, out, lse)`` and *recomputes* probabilities blockwise in the
+backward — the standard FLOPs-for-HBM trade that suits TPU (SURVEY.md §7
+hard part 1).
+
+One subtlety beyond the textbook recipe: this framework's attention returns
+``(out, lse)`` and downstream code **differentiates through lse as well** (the
+tree merge weighs shards by ``exp(lse - m)``). Since ``∂lse/∂logits`` is the
+softmax ``p`` itself, the lse cotangent folds into the standard backward as an
+extra additive term in the delta:
+
+    ds = p · (dout·vᵀ − Δ + dlse),   Δ = rowsum(dout ⊙ out)
+
+so supporting it costs nothing.
+
+The custom VJP wraps the *dispatcher* level: the forward runs whichever impl
+was requested (blockwise jnp or the Pallas kernel); the backward runs the
+blockwise jnp recomputation here, or the Pallas backward kernels when
+``impl='pallas'``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tree_attention_tpu.ops.reference import (
+    NEG_INF,
+    attention_blockwise,
+    attention_naive,
+)
+
+
+class _Cfg(NamedTuple):
+    causal: bool
+    scale: Optional[float]
+    impl: str
+    block_size: int
+
+
+def _zero_like_offset(x):
+    """Cotangent for integer offset args: float0 zeros of matching shape."""
+    return np.zeros(np.shape(x), dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _attn(cfg: _Cfg, q, k, v, q_offset, kv_offset):
+    return _raw_forward(cfg, q, k, v, q_offset, kv_offset)
+
+
+def _raw_forward(cfg, q, k, v, q_offset, kv_offset):
+    if cfg.impl == "blockwise":
+        return attention_blockwise(
+            q, k, v, causal=cfg.causal, scale=cfg.scale,
+            q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
+        )
+    if cfg.impl == "naive":
+        return attention_naive(
+            q, k, v, causal=cfg.causal, scale=cfg.scale,
+            q_offset=q_offset, kv_offset=kv_offset,
+        )
+    if cfg.impl == "pallas":
+        from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+
+        return attention_pallas_fwd(
+            q, k, v, causal=cfg.causal, scale=cfg.scale,
+            q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
+        )
+    raise ValueError(f"unknown impl {cfg.impl!r}")
+
+
+def _attn_fwd(cfg, q, k, v, q_offset, kv_offset):
+    out, lse = _raw_forward(cfg, q, k, v, q_offset, kv_offset)
+    return (out, lse), (q, k, v, out, lse, q_offset, kv_offset)
+
+
+def _attn_bwd(cfg, residuals, cotangents):
+    q, k, v, out, lse, q_offset, kv_offset = residuals
+    dout, dlse = cotangents
+    dq, dk, dv = attention_bwd_blockwise(
+        q, k, v, out, lse, dout, dlse,
+        causal=cfg.causal, scale=cfg.scale,
+        q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
+    )
+    return dq, dk, dv, _zero_like_offset(q_offset), _zero_like_offset(kv_offset)
+
+
+_attn.defvjp(_attn_fwd, _attn_bwd)
+
+
+def flash_attention_vjp(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    impl: str = "blockwise",
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Differentiable attention with the flash (recompute) backward."""
+    cfg = _Cfg(causal=causal, scale=scale, impl=impl, block_size=block_size)
+    return _attn(cfg, q, k, v, q_offset, kv_offset)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_size")
+)
+def attention_bwd_blockwise(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    dout: jax.Array,
+    dlse: jax.Array,
+    *,
+    causal: bool,
+    scale: Optional[float],
+    q_offset,
+    kv_offset,
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Blockwise jnp flash backward: recompute p from (q, k, lse) per block.
+
+    Grouped-query aware: dk/dv are reduced over the query-head group axis, so
+    KV (and their grads) stay ``Hkv``-sized throughout.
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    s = (D ** -0.5) if scale is None else scale
+
+    if Tk == 0:
+        return jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
+
+    blk = min(block_size, Tk)
+    num_blocks = (Tk + blk - 1) // blk
+    pad = num_blocks * blk - Tk
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else v
+
+    qf = q.astype(jnp.float32).reshape(B, Hkv, G, Tq, D)
+    doutf = dout.astype(jnp.float32).reshape(B, Hkv, G, Tq, D)
+    outf = out.astype(jnp.float32).reshape(B, Hkv, G, Tq, D)
+    lse_g = lse.reshape(B, Hkv, G, Tq)
+    dlse_g = dlse.astype(jnp.float32).reshape(B, Hkv, G, Tq)
+    # Fully-masked rows have lse = -inf; exp(logits - 0) with logits = -inf
+    # still gives p = 0, which is the correct (vanishing) gradient.
+    lse_safe = jnp.where(jnp.isneginf(lse_g), 0.0, lse_g)
+
+    # Δ folded with the lse cotangent (see module docstring).
+    delta = jnp.sum(doutf * outf, axis=-1) - dlse_g  # (B, Hkv, G, Tq)
+
+    kb = kp.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+
+    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 0)
+
+    def body(dq_acc, inputs):
+        blk_idx, k_blk, v_blk = inputs
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        logits = jnp.einsum(
+            "bhgqd,bhkd->bhgqk", qf, kf, preferred_element_type=jnp.float32
+        ) * s
+        start = blk_idx * blk
+        in_range = (start + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)) < Tk
+        valid = in_range
+        if causal:
+            k_pos = start + kv_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)
+            valid = valid & (q_pos >= k_pos)
+        logits = jnp.where(valid[None, None, None], logits, NEG_INF)
+
+        p = jnp.exp(logits - lse_safe[..., None])  # (B,Hkv,G,Tq,blk)
+        dp = jnp.einsum("bhgqd,bhkd->bhgqk", doutf, vf)
+        ds = p * (dp - delta[..., None])  # lse cotangent already folded in
+
+        dq_blk = jnp.einsum("bhgqk,bhkd->bhgqd", ds, kf) * s
+        dk_blk = jnp.einsum("bhgqk,bhgqd->bhkd", ds, qf) * s
+        dv_blk = jnp.einsum("bhgqk,bhgqd->bhkd", p, doutf)
+        return dq_acc + dq_blk, (dk_blk, dv_blk)
+
+    idxs = jnp.arange(num_blocks)
+    dq0 = jnp.zeros((B, Hkv, G, Tq, D), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(body, dq0, (idxs, kb, vb))
+
+    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(B, Hkv, num_blocks * blk, D)
+    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(B, Hkv, num_blocks * blk, D)
+    if pad:
+        dk = dk[:, :, :Tk]
+        dv = dv[:, :, :Tk]
+    return (
+        dq.reshape(B, Hq, Tq, D).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
