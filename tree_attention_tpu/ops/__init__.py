@@ -12,6 +12,7 @@ done right). Implementations:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -78,7 +79,15 @@ def flash_attention(
     if impl not in _IMPLS:
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "auto":
-        if _on_tpu() and _pallas_available():
+        # Pallas-on-TPU stays opt-in until verified on the target chip (the
+        # current axon tunnel wedges in Mosaic compile — see
+        # .claude/skills/verify/SKILL.md); the XLA blockwise path is the safe
+        # default everywhere.
+        if (
+            os.environ.get("TREE_ATTN_AUTO_PALLAS") == "1"
+            and _on_tpu()
+            and _pallas_available()
+        ):
             impl = "pallas"
         else:
             impl = "blockwise"
@@ -96,8 +105,17 @@ def flash_attention(
                 "impl='pallas' requested but the Pallas kernel module is not "
                 "available in this build; use impl='blockwise' or 'auto'"
             ) from e
-    if not custom_vjp and impl == "blockwise":
-        return attention_blockwise(
+    if not custom_vjp:
+        if impl == "blockwise":
+            return attention_blockwise(
+                q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                kv_offset=kv_offset, block_size=block_size,
+            )
+        # Raw Pallas forward: fine for inference; has no autodiff rules at
+        # all, so this is never silently worse than the custom VJP.
+        from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+
+        return attention_pallas_fwd(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset,
             kv_offset=kv_offset, block_size=block_size,
         )

@@ -1,0 +1,64 @@
+"""Shared KV-blocking and mask helpers for the blockwise forward/backward.
+
+The forward (``reference.attention_blockwise``) and the flash backward
+(``vjp.attention_bwd_blockwise``) must mask and pad *identically* or gradients
+silently diverge from the forward — so the logic lives once, here.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pad_to_block(x: jax.Array, dim: int, block: int) -> jax.Array:
+    """Zero-pad ``dim`` up to a multiple of ``block``."""
+    pad = (-x.shape[dim]) % block
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[dim] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def split_kv_blocks(
+    k: jax.Array, v: jax.Array, block: int
+) -> Tuple[jax.Array, jax.Array, int, int]:
+    """Reshape (B, Hkv, Tk, D) K/V into the (num_blocks, B, Hkv, blk, D) scan
+    layout, padding the tail block. Returns (kb, vb, num_blocks, blk)."""
+    B, Hkv, Tk, D = k.shape
+    blk = min(block, Tk)
+    kp = pad_to_block(k, 2, blk)
+    vp = pad_to_block(v, 2, blk)
+    num_blocks = kp.shape[2] // blk
+    kb = kp.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+    return kb, vb, num_blocks, blk
+
+
+def tile_mask(
+    tq: int,
+    blk: int,
+    blk_idx,
+    tk: int,
+    q_offset,
+    kv_offset,
+    causal: bool,
+) -> jax.Array:
+    """(tq, blk) visibility mask for one KV tile.
+
+    Combines the ragged-tail range check (padded keys beyond ``tk`` are
+    invalid) with cross-shard causality: query global position
+    ``q_offset + row`` sees key global position ``kv_offset + start + col``
+    iff q_pos >= k_pos.
+    """
+    start = blk_idx * blk
+    local_col = start + lax.broadcasted_iota(jnp.int32, (tq, blk), 1)
+    valid = local_col < tk
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (tq, blk), 0)
+        valid = valid & (q_pos >= kv_offset + local_col)
+    return valid

@@ -1,0 +1,217 @@
+"""Pallas TPU flash-attention forward kernel emitting ``(out, lse)``.
+
+This is the real version of what the reference's ``flash_res_lse`` only
+simulates (``/root/reference/model.py:60-83`` materialises the full score
+matrix; its README TODO at ``README.md:21`` admits flash attention was never
+integrated). Here the score matrix never exists: the kernel streams KV tiles
+through VMEM, maintains the online-softmax state ``(m, l, acc)`` in scratch
+across the (sequential) KV grid dimension, and writes ``out = acc/l`` and
+``lse = m + log l`` once per Q tile.
+
+TPU mapping:
+
+- Both matmuls (QKᵀ and P·V) hit the MXU with ``preferred_element_type=f32``;
+  tiles default to 128×512×head_dim.
+- Grid ``(B·Hq, Tq/bq, Tk/bk)``; the last dim iterates sequentially on TPU,
+  which is what lets scratch carry the running softmax state.
+- GQA is native: the K/V BlockSpec index map folds the query head down to its
+  KV head (no KV replication in HBM or VMEM).
+- Causal shard offsets arrive via SMEM scalars (they are traced values inside
+  ``shard_map``); fully-masked causal tiles skip both matmuls via ``pl.when``.
+- ``interpret=True`` runs the same kernel on CPU for cluster-free tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _flash_fwd_kernel(
+    offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
+    q_ref,     # VMEM (1, bq, D)
+    k_ref,     # VMEM (1, bk, D)
+    v_ref,     # VMEM (1, bk, D)
+    out_ref,   # VMEM (1, bq, D)
+    lse_ref,   # VMEM (1, bq, LANES) — lse broadcast across lanes (TPU tiling
+               # requires a 128-multiple trailing dim; host slices lane 0)
+    m_scr,     # VMEM (bq, LANES) f32
+    l_scr,     # VMEM (bq, LANES) f32
+    acc_scr,   # VMEM (bq, D) f32
+    *,
+    scale: float,
+    causal: bool,
+    tk: int,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    q_offset = offs_ref[0, 0]
+    kv_offset = offs_ref[1, 0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    k_start = ki * block_k
+    q_start = qi * block_q
+    # Global positions of this tile's rows/cols (shard offsets included).
+    row_pos = q_offset + q_start + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    col_idx = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    col_pos = kv_offset + col_idx
+
+    # A causal tile is dead when even its most-visible corner (last row,
+    # first col) is masked.
+    tile_live = True
+    if causal:
+        tile_live = (q_offset + q_start + block_q - 1) >= (kv_offset + k_start)
+
+    @pl.when(tile_live)
+    def _compute():
+        s = lax.dot_general(
+            q_ref[0].astype(jnp.float32),
+            k_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+
+        valid = col_idx < tk  # mask host-side padding of ragged Tk
+        if causal:
+            valid = valid & (row_pos >= col_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+        p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        empty = l <= 0.0
+        l_safe = jnp.where(empty, 1.0, l)
+        out_ref[0] = (
+            jnp.where(empty, 0.0, acc_scr[...] / l_safe)
+        ).astype(out_ref.dtype)
+        lse = jnp.where(
+            empty, NEG_INF, jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)
+        )
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+from tree_attention_tpu.ops.block_utils import pad_to_block as _pad_dim  # noqa: E402
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_size", "block_q", "interpret"),
+)
+def attention_pallas_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_size: int = 512,
+    block_q: int = 256,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Raw (non-differentiable) Pallas forward. Same contract as the jnp impls.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere —
+    the same kernel code path is what CI exercises on CPU.
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    s = (D ** -0.5) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    if Tk == 0:
+        return jnp.zeros_like(q), jnp.full((B, Hq, Tq), NEG_INF, jnp.float32)
+
+    bq = min(block_q, max(Tq, 8))
+    bk = min(block_size, max(Tk, _LANES))
+
+    qp = _pad_dim(q.reshape(B * Hq, Tq, D), 1, bq)
+    kp = _pad_dim(k.reshape(B * Hkv, Tk, D), 1, bk)
+    vp = _pad_dim(v.reshape(B * Hkv, Tk, D), 1, bk)
+    tq_pad, tk_pad = qp.shape[1], kp.shape[1]
+
+    offs = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    ).reshape(2, 1)
+
+    grid = (B * Hq, tq_pad // bq, tk_pad // bk)
+
+    def kv_index(bh, qi, ki):
+        b, hq = bh // Hq, bh % Hq
+        return (b * Hkv + hq // G, ki, 0)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel,
+            scale=s, causal=causal, tk=Tk, block_q=bq, block_k=bk,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), kv_index),
+            pl.BlockSpec((1, bk, D), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * Hq, tq_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((B * Hq, tq_pad, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qp, kp, vp)
+
+    out = out[:, :Tq].reshape(B, Hq, Tq, D)
+    lse = lse[:, :Tq, 0].reshape(B, Hq, Tq)
+    return out, lse

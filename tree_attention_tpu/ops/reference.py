@@ -172,23 +172,14 @@ def attention_blockwise(
             jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),
         )
 
-    blk = min(block_size, Tk)
-    num_blocks = (Tk + blk - 1) // blk
-    pad = num_blocks * blk - Tk
-    if pad:
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    from tree_attention_tpu.ops.block_utils import split_kv_blocks, tile_mask
 
     qf = (q.astype(jnp.float32) * s).reshape(B, Hkv, G, Tq, D)
-    # (num_blocks, B, Hkv, blk, D) scan layout
-    kb = k.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
-    vb = v.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
+    kb, vb, num_blocks, blk = split_kv_blocks(k, v, block_size)
 
     m0 = jnp.full((B, Hkv, G, Tq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Hkv, G, Tq), jnp.float32)
     acc0 = jnp.zeros((B, Hkv, G, Tq, D), jnp.float32)
-
-    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 0)
 
     def body(carry, inputs):
         m_prev, l_prev, acc = carry
@@ -197,11 +188,7 @@ def attention_blockwise(
             "bhgqd,bhkd->bhgqk", qf, k_blk.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        start = blk_idx * blk
-        k_pos = start + kv_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)
-        valid = (start + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)) < Tk
-        if causal:
-            valid = valid & (q_pos >= k_pos)
+        valid = tile_mask(Tq, blk, blk_idx, Tk, q_offset, kv_offset, causal)
         logits = jnp.where(valid[None, None, None], logits, NEG_INF)
 
         m_blk = jnp.max(logits, axis=-1)

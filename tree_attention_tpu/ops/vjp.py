@@ -17,9 +17,10 @@ extra additive term in the delta:
 so supporting it costs nothing.
 
 The custom VJP wraps the *dispatcher* level: the forward runs whichever impl
-was requested (blockwise jnp or the Pallas kernel); the backward runs the
-blockwise jnp recomputation here, or the Pallas backward kernels when
-``impl='pallas'``.
+was requested (blockwise jnp or the Pallas kernel); the backward currently
+always runs the blockwise jnp recomputation below (Pallas backward kernels
+are a planned swap-in at the same seam — ``_attn_bwd`` is the single place
+they plug in).
 """
 
 from __future__ import annotations
@@ -144,11 +145,7 @@ def attention_bwd_blockwise(
     if Tk == 0:
         return jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
 
-    blk = min(block_size, Tk)
-    num_blocks = (Tk + blk - 1) // blk
-    pad = num_blocks * blk - Tk
-    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else k
-    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else v
+    from tree_attention_tpu.ops.block_utils import split_kv_blocks, tile_mask
 
     qf = q.astype(jnp.float32).reshape(B, Hkv, G, Tq, D)
     doutf = dout.astype(jnp.float32).reshape(B, Hkv, G, Tq, D)
@@ -162,10 +159,7 @@ def attention_bwd_blockwise(
     # Δ folded with the lse cotangent (see module docstring).
     delta = jnp.sum(doutf * outf, axis=-1) - dlse_g  # (B, Hkv, G, Tq)
 
-    kb = kp.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
-    vb = vp.reshape(B, Hkv, num_blocks, blk, D).transpose(2, 0, 1, 3, 4)
-
-    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 0)
+    kb, vb, num_blocks, blk = split_kv_blocks(k, v, block_size)
 
     def body(dq_acc, inputs):
         blk_idx, k_blk, v_blk = inputs
@@ -174,12 +168,7 @@ def attention_bwd_blockwise(
         logits = jnp.einsum(
             "bhgqd,bhkd->bhgqk", qf, kf, preferred_element_type=jnp.float32
         ) * s
-        start = blk_idx * blk
-        in_range = (start + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)) < Tk
-        valid = in_range
-        if causal:
-            k_pos = start + kv_offset + lax.broadcasted_iota(jnp.int32, (Tq, blk), 1)
-            valid = valid & (q_pos >= k_pos)
+        valid = tile_mask(Tq, blk, blk_idx, Tk, q_offset, kv_offset, causal)
         logits = jnp.where(valid[None, None, None], logits, NEG_INF)
 
         p = jnp.exp(logits - lse_safe[..., None])  # (B,Hkv,G,Tq,blk)
@@ -197,9 +186,8 @@ def attention_bwd_blockwise(
 
     dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(B, Hkv, num_blocks * blk, D)
     dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(B, Hkv, num_blocks * blk, D)
-    if pad:
-        dk = dk[:, :, :Tk]
-        dv = dv[:, :, :Tk]
+    dk = dk[:, :, :Tk]
+    dv = dv[:, :, :Tk]
     return (
         dq.reshape(B, Hq, Tq, D).astype(q.dtype),
         dk.astype(k.dtype),
