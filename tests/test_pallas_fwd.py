@@ -74,8 +74,8 @@ def test_bf16():
     )
 
 
-def test_dispatcher_impl_pallas_grads_via_blockwise_bwd():
-    """flash_attention(impl='pallas'): pallas fwd + blockwise bwd custom VJP."""
+def test_dispatcher_impl_pallas_end_to_end_grads():
+    """flash_attention(impl='pallas'): pallas fwd + pallas bwd custom VJP."""
     import jax
     from tree_attention_tpu.ops import flash_attention
 

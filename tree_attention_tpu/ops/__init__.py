@@ -6,8 +6,11 @@ done right). Implementations:
 
 - ``"naive"``     — materialised scores, test oracle (:mod:`.reference`)
 - ``"blockwise"`` — online-softmax ``lax.scan``, any backend (:mod:`.reference`)
-- ``"pallas"``    — Pallas TPU kernel, fwd+bwd (:mod:`.pallas_attention`)
-- ``"auto"``      — pallas on TPU, blockwise elsewhere
+- ``"pallas"``    — Pallas TPU kernels, fwd (:mod:`.pallas_attention`) +
+  bwd (:mod:`.pallas_bwd`)
+- ``"auto"``      — blockwise everywhere by default; resolves to pallas on
+  TPU only when ``TREE_ATTN_AUTO_PALLAS=1`` (opt-in until the kernel is
+  verified on the target chip)
 """
 
 from __future__ import annotations

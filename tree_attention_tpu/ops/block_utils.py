@@ -39,6 +39,33 @@ def split_kv_blocks(
     return kb, vb, num_blocks, blk
 
 
+def tile_geometry(qi, ki, block_q: int, block_k: int, q_offset, kv_offset):
+    """Per-tile global positions for the Pallas kernels (rows = Q, cols = K).
+
+    Returns ``(row_pos, col_idx, col_pos)`` of shape (block_q, block_k):
+    global query positions, local key column indices (for the ragged-tail
+    check against Tk), and global key positions. Forward and both backward
+    kernels must use this one definition or their masks diverge.
+    """
+    q_start = qi * block_q
+    k_start = ki * block_k
+    row_pos = q_offset + q_start + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    col_idx = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    col_pos = kv_offset + col_idx
+    return row_pos, col_idx, col_pos
+
+
+def tile_live(qi, ki, block_q: int, block_k: int, q_offset, kv_offset,
+              causal: bool):
+    """Whether a (Q-tile, KV-tile) pair has any visible entry under causality:
+    live iff the most-visible corner (last row, first col) is unmasked."""
+    if not causal:
+        return True
+    return (q_offset + qi * block_q + block_q - 1) >= (kv_offset + ki * block_k)
+
+
 def tile_mask(
     tq: int,
     blk: int,

@@ -32,6 +32,12 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tree_attention_tpu.ops.block_utils import (
+    pad_to_block as _pad_dim,
+    tile_geometry,
+    tile_live,
+)
+
 NEG_INF = float("-inf")
 _LANES = 128
 
@@ -67,22 +73,11 @@ def _flash_fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    k_start = ki * block_k
-    q_start = qi * block_q
-    # Global positions of this tile's rows/cols (shard offsets included).
-    row_pos = q_offset + q_start + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+    row_pos, col_idx, col_pos = tile_geometry(
+        qi, ki, block_q, block_k, q_offset, kv_offset
     )
-    col_idx = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    col_pos = kv_offset + col_idx
 
-    # A causal tile is dead when even its most-visible corner (last row,
-    # first col) is masked.
-    tile_live = True
-    if causal:
-        tile_live = (q_offset + q_start + block_q - 1) >= (kv_offset + k_start)
-
-    @pl.when(tile_live)
+    @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _compute():
         s = lax.dot_general(
             q_ref[0].astype(jnp.float32),
@@ -127,7 +122,6 @@ def _flash_fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-from tree_attention_tpu.ops.block_utils import pad_to_block as _pad_dim  # noqa: E402
 
 
 @functools.partial(

@@ -1,0 +1,276 @@
+"""Pallas TPU flash-attention backward kernels.
+
+Two kernels, the standard split (SURVEY.md §7 hard part 1):
+
+- **dQ kernel** — grid ``(B·Hq, Tq/bq, Tk/bk)``: for one Q tile, stream KV
+  tiles, accumulate ``dq += ds·K·scale`` in VMEM scratch.
+- **dKV kernel** — grid ``(B·Hkv, Tk/bk, G·Tq/bq)``: for one KV tile, stream
+  every query head of the group and every Q tile, accumulate
+  ``dk += dsᵀ·Q·scale`` and ``dv += pᵀ·dO`` in scratch. GQA reduction over
+  the group happens in-register — KV gradients never materialise per
+  query head.
+
+Both recompute ``p = exp(q·kᵀ·scale − lse)`` from the saved lse (no stored
+probabilities), and consume a host-precomputed
+``delta = rowsum(dO ⊙ O) − dlse`` — the lse-cotangent folding described in
+:mod:`tree_attention_tpu.ops.vjp`. Padded query rows are neutralised by
+padding lse with ``+inf`` (making ``p`` exactly 0 there); padded key columns
+by the in-kernel range mask. Causally dead tiles skip all compute via
+``pl.when``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tree_attention_tpu.ops.block_utils import (
+    pad_to_block,
+    tile_geometry,
+    tile_live,
+)
+
+NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
+                    row_pos, col_idx, col_pos, tk):
+    """p and ds for one (Q-tile, KV-tile) pair, f32."""
+    s = lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    valid = col_idx < tk
+    if causal:
+        valid = valid & (row_pos >= col_pos)
+    s = jnp.where(valid, s, NEG_INF)
+    # lse is padded with +inf on padded rows -> p == 0 there; masked cols give
+    # exp(-inf - lse) == 0.
+    p = jnp.exp(s - lse)
+    dp = lax.dot_general(
+        dout, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta)
+    return p, ds
+
+
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, scale, causal, tk, block_q, block_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+    q_offset, kv_offset = offs_ref[0, 0], offs_ref[1, 0]
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    row_pos, col_idx, col_pos = tile_geometry(
+        qi, ki, block_q, block_k, q_offset, kv_offset
+    )
+
+    @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
+    def _():
+        kf = k_ref[0].astype(jnp.float32)
+        _, ds = _recompute_p_ds(
+            q_ref[0].astype(jnp.float32), kf, v_ref[0].astype(jnp.float32),
+            do_ref[0].astype(jnp.float32), lse_ref[0][:, :1],
+            delta_ref[0][:, :1],
+            scale=scale, causal=causal,
+            row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
+        )
+        dq_scr[...] += lax.dot_general(
+            ds, kf, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, tk, block_q, block_k, n_q):
+    ki, gq = pl.program_id(1), pl.program_id(2)
+    n_gq = pl.num_programs(2)
+    q_offset, kv_offset = offs_ref[0, 0], offs_ref[1, 0]
+
+    @pl.when(gq == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # gq enumerates (g, qi) pairs — same decoding as the BlockSpec index maps.
+    qi = gq % n_q
+
+    row_pos, col_idx, col_pos = tile_geometry(
+        qi, ki, block_q, block_k, q_offset, kv_offset
+    )
+
+    @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
+    def _():
+        qf = q_ref[0].astype(jnp.float32)
+        dof = do_ref[0].astype(jnp.float32)
+        p, ds = _recompute_p_ds(
+            qf, k_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            dof, lse_ref[0][:, :1], delta_ref[0][:, :1],
+            scale=scale, causal=causal,
+            row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
+        )
+        dk_scr[...] += lax.dot_general(
+            ds, qf, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        dv_scr[...] += lax.dot_general(
+            p, dof, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(gq == n_gq - 1)
+    def _():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_size", "block_q", "interpret"),
+)
+def attention_bwd_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    dout: jax.Array,
+    dlse: jax.Array,
+    *,
+    causal: bool,
+    scale: Optional[float],
+    q_offset=0,
+    kv_offset=0,
+    block_size: int = 512,
+    block_q: int = 256,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pallas backward: same contract as ``attention_bwd_blockwise``."""
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    s = (D ** -0.5) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if Tk == 0:
+        return jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
+
+    bq = min(block_q, max(Tq, 8))
+    bk = min(block_size, max(Tk, _LANES))
+
+    qp = pad_to_block(q.reshape(B * Hq, Tq, D), 1, bq)
+    dop = pad_to_block(dout.reshape(B * Hq, Tq, D), 1, bq)
+    kp = pad_to_block(k.reshape(B * Hkv, Tk, D), 1, bk)
+    vp = pad_to_block(v.reshape(B * Hkv, Tk, D), 1, bk)
+    tq_pad, tk_pad = qp.shape[1], kp.shape[1]
+    n_q, n_k = tq_pad // bq, tk_pad // bk
+
+    # delta with the lse cotangent folded in; +inf-pad lse so padded rows
+    # recompute p == 0 (see module docstring).
+    delta = (
+        jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+        - dlse.astype(jnp.float32)
+    ).reshape(B * Hq, Tq)
+    pad_rows = tq_pad - Tq
+    # Rows with no visible keys carry lse == -inf; the in-kernel recompute
+    # would hit exp(-inf - (-inf)) == nan wherever the causal boundary
+    # straddles a tile. Mapping them to +inf makes p exactly 0 for the whole
+    # row — the correct vanishing gradient — same neutralisation as the
+    # padded rows below.
+    lse_f = jnp.where(jnp.isneginf(lse), jnp.inf, lse).reshape(B * Hq, Tq)
+    if pad_rows:
+        lse_f = jnp.pad(lse_f, ((0, 0), (0, pad_rows)), constant_values=jnp.inf)
+        delta = jnp.pad(delta, ((0, 0), (0, pad_rows)))
+    # Lane-broadcast layout (B*Hq, tq_pad, 128): TPU tiling rejects (1, bq)
+    # blocks of a 2-D (B*Hq, tq_pad) array (sublane dim 1 is neither 8-aligned
+    # nor full), so per-row scalars ride a 128-lane axis — same layout the
+    # in-tree flash kernels use for their l/m residuals. Costs 128x the lse
+    # HBM footprint; acceptable because lse is 1/D of the out tensor.
+    lse_b = jnp.broadcast_to(lse_f[..., None], (B * Hq, tq_pad, _LANES))
+    delta_b = jnp.broadcast_to(delta[..., None], (B * Hq, tq_pad, _LANES))
+
+    offs = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    ).reshape(2, 1)
+
+    def kv_from_qrow(bh, *_rest):
+        return bh // Hq * Hkv + (bh % Hq) // G
+
+    # ---- dQ ----
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=s, causal=causal, tk=Tk, block_q=bq, block_k=bk,
+        ),
+        grid=(B * Hq, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki, 0)),
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * Hq, tq_pad, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(offs, qp, kp, vp, dop, lse_b, delta_b)
+
+    # ---- dK, dV ----
+    def q_from_kvrow(bkh, ki, gq):
+        b, hkv = bkh // Hkv, bkh % Hkv
+        g = gq // n_q
+        return b * Hq + hkv * G + g
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=s, causal=causal, tk=Tk, block_q=bq,
+            block_k=bk, n_q=n_q,
+        ),
+        grid=(B * Hkv, n_k, G * n_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
+            pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
+            pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * Hkv, tk_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, tk_pad, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qp, kp, vp, dop, lse_b, delta_b)
+
+    return (
+        dq[:, :Tq].reshape(B, Hq, Tq, D),
+        dk[:, :Tk].reshape(B, Hkv, Tk, D),
+        dv[:, :Tk].reshape(B, Hkv, Tk, D),
+    )

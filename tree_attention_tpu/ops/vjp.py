@@ -17,10 +17,10 @@ extra additive term in the delta:
 so supporting it costs nothing.
 
 The custom VJP wraps the *dispatcher* level: the forward runs whichever impl
-was requested (blockwise jnp or the Pallas kernel); the backward currently
-always runs the blockwise jnp recomputation below (Pallas backward kernels
-are a planned swap-in at the same seam — ``_attn_bwd`` is the single place
-they plug in).
+was requested; the backward matches it — ``impl='pallas'`` runs the Pallas
+backward kernels (:mod:`tree_attention_tpu.ops.pallas_bwd`), everything else
+runs the blockwise jnp recomputation below. ``_attn_bwd`` is the single
+dispatch seam.
 """
 
 from __future__ import annotations
@@ -86,7 +86,13 @@ def _attn_fwd(cfg, q, k, v, q_offset, kv_offset):
 def _attn_bwd(cfg, residuals, cotangents):
     q, k, v, out, lse, q_offset, kv_offset = residuals
     dout, dlse = cotangents
-    dq, dk, dv = attention_bwd_blockwise(
+    if cfg.impl == "pallas":
+        from tree_attention_tpu.ops.pallas_bwd import attention_bwd_pallas
+
+        bwd = attention_bwd_pallas
+    else:
+        bwd = attention_bwd_blockwise
+    dq, dk, dv = bwd(
         q, k, v, out, lse, dout, dlse,
         causal=cfg.causal, scale=cfg.scale,
         q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
