@@ -1,0 +1,23 @@
+"""Model family: decoder-only transformer LMs over tree attention.
+
+The flagship model exercising the framework the way the reference's driver
+exercises its op (``/root/reference/model.py:129-155``) — but as a real LM
+with parameters, a loss, and a sharded training step.
+"""
+
+from tree_attention_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    count_params,
+    cross_entropy_loss,
+    forward,
+    init_params,
+    loss_fn,
+    param_shardings,
+    param_specs,
+)
+from tree_attention_tpu.models.train import (  # noqa: F401
+    default_optimizer,
+    init_train_state,
+    make_train_step,
+    shard_batch,
+)

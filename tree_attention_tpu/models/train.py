@@ -1,0 +1,142 @@
+"""Training step: optimizer wiring + sharded jit compilation.
+
+The reference has no training path at all (forward decode only, no backward —
+``/root/reference/model.py:129-155``); BASELINE.json configs 2/5 require
+fwd+bwd. This module turns :func:`tree_attention_tpu.models.transformer.loss_fn`
+into a compiled SPMD train step:
+
+- gradients via ``jax.value_and_grad`` through the flash custom VJP and the
+  tree-attention collectives (the backward of ``all_gather`` is
+  ``psum_scatter`` and vice versa, so the gradient communication mirrors the
+  forward automatically);
+- optimizer state sharded like the params (optax state is a pytree of
+  param-shaped leaves, so the same ``NamedSharding`` tree applies);
+- one ``jit`` with explicit in/out shardings — XLA sees the whole step
+  (forward, backward, update) and fuses/overlaps across it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tree_attention_tpu.models.transformer import (
+    Params,
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    param_shardings,
+)
+from tree_attention_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+TrainState = Tuple[Params, Any]  # (params, opt_state)
+
+
+def default_optimizer(
+    learning_rate: float = 3e-4, weight_decay: float = 0.01, grad_clip: float = 1.0
+) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def _axes_in_mesh(mesh: Optional[Mesh], data_axis, seq_axis, model_axis):
+    """Drop axis names the mesh doesn't actually carry (so one call site works
+    for 1-axis seq-only meshes and full data×seq×model meshes alike)."""
+    if mesh is None:
+        return None, seq_axis, None
+    present = lambda a: a if (a is not None and a in mesh.shape) else None
+    return present(data_axis), present(seq_axis), present(model_axis)
+
+
+def init_train_state(
+    key: jax.Array,
+    cfg: TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh: Optional[Mesh] = None,
+    model_axis: Optional[str] = AXIS_MODEL,
+) -> TrainState:
+    """Initialise (params, opt_state), sharded over ``mesh`` if given.
+
+    Initialisation runs under ``jit`` with output shardings so large models
+    materialise directly as shards — no host-side full copy (the reference
+    builds full tensors on host then ships them, ``model.py:51-53``).
+    """
+    if mesh is None:
+        params = init_params(key, cfg)
+        return params, optimizer.init(params)
+
+    shardings = param_shardings(cfg, mesh, model_axis=model_axis)
+    params = jax.jit(
+        lambda k: init_params(k, cfg), out_shardings=shardings
+    )(key)
+    return params, _sharded_opt_init(optimizer, params, mesh)
+
+
+def _sharded_opt_init(optimizer, params, mesh):
+    """optax state leaves are either param-shaped (shard like the param) or
+    scalars (replicate); derive shardings structurally from eval_shape."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    shape_to_sharding = {}
+    for p in jax.tree.leaves(params):
+        shape_to_sharding.setdefault(p.shape, p.sharding)
+    replicated = NamedSharding(mesh, P())
+
+    def pick(leaf):
+        return shape_to_sharding.get(leaf.shape, replicated)
+
+    out_shardings = jax.tree.map(pick, shapes)
+    return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = AXIS_DATA,
+    seq_axis: str = AXIS_SEQ,
+    model_axis: Optional[str] = AXIS_MODEL,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, jax.Array]]:
+    """Build the compiled ``(state, batch) -> (state, loss)`` step.
+
+    Batch arrays are expected sharded ``P(data, seq)`` on (B, T); params/opt
+    state as from :func:`init_train_state`. Donation reuses the old state's
+    buffers for the new one — at-most-one params copy resident, which matters
+    at long context where activations already crowd HBM.
+    """
+    data_axis, seq_axis, model_axis = _axes_in_mesh(
+        mesh, data_axis, seq_axis, model_axis
+    )
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, batch, cfg,
+            mesh=mesh, data_axis=data_axis, seq_axis=seq_axis,
+            model_axis=model_axis,
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    donate_argnums = (0,) if donate else ()
+    # Shardings are carried by the arrays themselves (init_train_state for the
+    # state, shard_batch for the batch) — no pinned in_shardings, so optional
+    # batch keys like "mask" work without a separate compiled signature.
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def shard_batch(mesh: Mesh, batch: Dict[str, jax.Array], *,
+                data_axis: Optional[str] = AXIS_DATA,
+                seq_axis: str = AXIS_SEQ) -> Dict[str, jax.Array]:
+    data_axis, seq_axis, _ = _axes_in_mesh(mesh, data_axis, seq_axis, None)
+    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    return {k: jax.device_put(v, sharding) for k, v in batch.items()}

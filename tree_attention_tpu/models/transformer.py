@@ -1,0 +1,333 @@
+"""Decoder-only transformer LM built on the tree-attention ops layer.
+
+The reference repo has no model — its driver calls the attention op on random
+tensors (``/root/reference/model.py:129-155``). A framework needs a flagship
+model family to exercise the kernel the way users will: this module provides a
+Llama-style decoder-only LM (RMSNorm, rotary embeddings, SwiGLU, grouped-query
+attention) written as pure functions over a pytree of parameters.
+
+TPU-first design choices:
+
+- **Layers are stacked and scanned** (``lax.scan`` over a leading layer axis)
+  so the program XLA sees is O(1) in depth — one compiled layer body — with
+  ``jax.checkpoint`` on the body for rematerialised activations (HBM ↔ FLOPs
+  trade, SURVEY.md §7).
+- **Attention routes through the tree layer when a mesh is given**: activations
+  stay sequence-sharded end-to-end (embeddings/norms/FFN are pointwise over
+  sequence, so GSPMD shards them for free) and only the attention inner loop
+  uses explicit collectives via :func:`tree_attention
+  <tree_attention_tpu.parallel.tree.tree_attention>`.
+- **bf16 params / fp32 norms & softmax**: the TPU-native half precision, with
+  reductions carried in float32 (the reference uses fp16 throughout,
+  ``model.py:51-53``; see SURVEY.md §7 numerics policy).
+- **Sharding is data, not code**: :func:`param_specs` returns a
+  ``PartitionSpec`` pytree mirroring :func:`init_params` — megatron-style
+  tensor parallelism over the ``model`` axis, batch over ``data``, sequence
+  over ``seq`` — and the same forward runs unsharded on one chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Static architecture hyperparameters (hashable: usable as a jit static)."""
+
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    n_kv_heads: int = 8          # < n_heads for GQA/MQA
+    d_head: int = 64
+    d_ff: int = 1408             # ~8/3 · d_model, rounded to a lane multiple
+    max_seq_len: int = 65536
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16    # activation/param compute dtype
+    attn_impl: str = "auto"      # flash_attention impl selector
+    attn_block_size: int = 512
+    remat: bool = True           # checkpoint each layer body under scan
+
+    def __post_init__(self):
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be a multiple of "
+                f"n_kv_heads ({self.n_kv_heads})"
+            )
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.d_head
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.d_head
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialisation and sharding specs (two pytrees, one shape)
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
+    """Initialise the parameter pytree.
+
+    Per-layer weights carry a leading ``n_layers`` axis so the forward pass can
+    ``lax.scan`` over depth. Residual-output projections (``wo``, ``w2``) are
+    scaled by ``(2·n_layers)^-1/2`` so the residual stream's variance stays O(1)
+    at init regardless of depth.
+    """
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    L, D = cfg.n_layers, cfg.d_model
+    std = 0.02
+    res_std = std / (2 * cfg.n_layers) ** 0.5
+
+    def normal(key, shape, stddev):
+        return (jax.random.normal(key, shape, jnp.float32) * stddev).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 6)
+    layers = {
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "wq": normal(ks[0], (L, D, cfg.q_dim), std),
+        "wk": normal(ks[1], (L, D, cfg.kv_dim), std),
+        "wv": normal(ks[2], (L, D, cfg.kv_dim), std),
+        "wo": normal(ks[3], (L, cfg.q_dim, D), res_std),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "w1": normal(ks[4], (L, D, cfg.d_ff), std),
+        "w3": normal(ks[5], (L, D, cfg.d_ff), std),
+        "w2": normal(jax.random.fold_in(ks[5], 1), (L, cfg.d_ff, D), res_std),
+    }
+    return {
+        "embed": normal(k_embed, (cfg.vocab_size, D), std),
+        "layers": layers,
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "wout": normal(k_out, (D, cfg.vocab_size), std),
+    }
+
+
+def param_specs(
+    cfg: TransformerConfig,
+    *,
+    data_axis: Optional[str] = AXIS_DATA,
+    model_axis: Optional[str] = AXIS_MODEL,
+) -> Params:
+    """``PartitionSpec`` pytree mirroring :func:`init_params`.
+
+    Megatron-style tensor parallelism: column-parallel in-projections
+    (``wq/wk/wv/w1/w3`` shard their output features over ``model_axis``),
+    row-parallel out-projections (``wo/w2`` shard their input features), so the
+    only TP collective per block is the psum XLA inserts after the row-parallel
+    matmul. Embedding/unembedding shard the vocab-orthogonal feature dim.
+    ``data_axis`` is accepted for signature symmetry (params are never
+    batch-sharded).
+    """
+    del data_axis
+    m = model_axis
+    return {
+        "embed": P(None, m),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, None, m),
+            "wk": P(None, None, m),
+            "wv": P(None, None, m),
+            "wo": P(None, m, None),
+            "ln2": P(None, None),
+            "w1": P(None, None, m),
+            "w3": P(None, None, m),
+            "w2": P(None, m, None),
+        },
+        "ln_f": P(None),
+        "wout": P(None, m),
+    }
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh, **kw) -> Params:
+    specs = param_specs(cfg, **kw)
+
+    def to_sharding(spec: P) -> NamedSharding:
+        # Drop axis names the mesh doesn't carry, so the same spec tree works
+        # on a seq-only mesh and a full data×seq×model mesh.
+        pruned = P(*(a if a in mesh.shape else None for a in spec))
+        return NamedSharding(mesh, pruned)
+
+    return jax.tree.map(to_sharding, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def count_params(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return ((x32 * rms) * w).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding on ``(B, H, T, D)`` with global ``positions (T,)``.
+
+    Positions are *global* sequence indices: under sequence parallelism each
+    shard passes its own offset slice, so rotations agree across the mesh.
+    """
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+def _heads(x: jax.Array, n_heads: int, d_head: int) -> jax.Array:
+    """(B, T, H·D) -> (B, H, T, D) — the ops-layer layout."""
+    B, T, _ = x.shape
+    return x.reshape(B, T, n_heads, d_head).transpose(0, 2, 1, 3)
+
+
+def _unheads(x: jax.Array) -> jax.Array:
+    """(B, H, T, D) -> (B, T, H·D)."""
+    B, H, T, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+
+
+def _attention_block(
+    p: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh],
+    axes: Dict[str, Optional[str]],
+) -> jax.Array:
+    q = _heads(x @ p["wq"], cfg.n_heads, cfg.d_head)
+    k = _heads(x @ p["wk"], cfg.n_kv_heads, cfg.d_head)
+    v = _heads(x @ p["wv"], cfg.n_kv_heads, cfg.d_head)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    if mesh is not None and mesh.shape.get(axes["seq"], 1) > 1:
+        from tree_attention_tpu.parallel.tree import tree_attention
+
+        out, _ = tree_attention(
+            q, k, v,
+            mesh=mesh,
+            seq_axis=axes["seq"],
+            data_axis=axes["data"],
+            head_axis=axes["model"],
+            causal=True,
+            impl=cfg.attn_impl,
+            block_size=cfg.attn_block_size,
+        )
+    else:
+        out, _ = flash_attention(
+            q, k, v,
+            causal=True,
+            impl=cfg.attn_impl,
+            block_size=cfg.attn_block_size,
+        )
+    return _unheads(out) @ p["wo"]
+
+
+def _mlp_block(p: Params, x: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ p["w1"]) * (x @ p["w3"])) @ p["w2"]
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = AXIS_DATA,
+    seq_axis: str = AXIS_SEQ,
+    model_axis: Optional[str] = AXIS_MODEL,
+) -> jax.Array:
+    """Token ids ``(B, T)`` -> logits ``(B, T, vocab)`` (float32).
+
+    With ``mesh``, activations are constrained to ``P(data, seq, None)`` so
+    the residual stream stays sequence-sharded between tree-attention calls;
+    without it, this is a plain single-device forward.
+    """
+    axes = {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    if mesh is not None:
+        axes = {k: (a if a in mesh.shape else None) for k, a in axes.items()}
+        act_spec = P(axes["data"], axes["seq"], None)
+
+    def constrain(x):
+        if mesh is None:
+            return x
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, act_spec))
+
+    T = tokens.shape[1]
+    positions = jnp.arange(T, dtype=jnp.int32)
+    x = constrain(jnp.take(params["embed"], tokens, axis=0))
+
+    def body(x, layer):
+        x = x + constrain(
+            _attention_block(
+                layer, rms_norm(x, layer["ln1"], cfg.norm_eps),
+                positions, cfg, mesh, axes,
+            )
+        )
+        x = x + constrain(_mlp_block(layer, rms_norm(x, layer["ln2"], cfg.norm_eps)))
+        return x, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["ln_f"], cfg.norm_eps)
+    return (x @ params["wout"]).astype(jnp.float32)
+
+
+def cross_entropy_loss(
+    logits: jax.Array, targets: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """Mean next-token cross entropy in float32. ``targets``/``mask``: (B, T)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+    **fwd_kw,
+) -> jax.Array:
+    """Batch = {"inputs": (B,T) ids, "targets": (B,T) ids, optional "mask"}.
+
+    Inputs/targets are pre-shifted at the data layer so both have length T —
+    keeping T divisible by the sequence-parallel shard count (a ``T-1`` shift
+    inside the model would break the mesh divisibility contract).
+    """
+    logits = forward(params, batch["inputs"], cfg, **fwd_kw)
+    return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))

@@ -13,6 +13,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# The one definition of the masking sentinel and the TPU vector lane width —
+# every impl must mask with the same -inf and tile to the same lane count.
+NEG_INF = float("-inf")
+LANES = 128
+
 
 def pad_to_block(x: jax.Array, dim: int, block: int) -> jax.Array:
     """Zero-pad ``dim`` up to a multiple of ``block``."""

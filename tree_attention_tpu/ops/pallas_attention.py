@@ -38,8 +38,7 @@ from tree_attention_tpu.ops.block_utils import (
     tile_live,
 )
 
-NEG_INF = float("-inf")
-_LANES = 128
+from tree_attention_tpu.ops.block_utils import LANES as _LANES, NEG_INF
 
 
 def _flash_fwd_kernel(

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-NEG_INF = float("-inf")
+from tree_attention_tpu.ops.block_utils import NEG_INF  # noqa: F401  (canonical home)
 
 
 def _expand_gqa(k: jax.Array, v: jax.Array, num_q_heads: int) -> Tuple[jax.Array, jax.Array]:
