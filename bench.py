@@ -1,0 +1,65 @@
+"""Headline benchmark: long-context decode throughput on one chip.
+
+Workload = the reference's hardcoded driver config
+(``/root/reference/model.py:140-145,51-53``): B=1, 16 heads, head_dim=128,
+seq_len=64000, q_len=1 — one decode step of exact attention over a 64k-token
+KV cache. The reference runs it in fp16 on CPU in ≈5.74 s (BASELINE.md,
+measured 2026-07-29; the reference publishes no numbers of its own, and its
+distributed path crashes, so the single-process run is the only baseline that
+exists). Here the same workload runs through ``flash_attention`` on the TPU
+chip in bf16 (the TPU-native half precision).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is decode KV-tokens/sec and vs_baseline is the speedup over the reference's
+64000 tokens / 5.74 s.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tree_attention_tpu.ops import flash_attention
+
+B, H, D, T = 1, 16, 128, 64000
+BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
+
+
+def main() -> None:
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, 1, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, H, T, D), jnp.bfloat16)
+
+    fn = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=False, block_size=2048)
+    )
+    out, lse = fn(q, k, v)  # compile + warm
+    jax.block_until_ready((out, lse))
+    assert out.shape == (B, H, 1, D) and lse.shape == (B, H, 1)
+
+    iters = 50
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(q, k, v))
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]  # median
+
+    tokens_per_sec = T / dt
+    print(
+        json.dumps(
+            {
+                "metric": "decode_kv_tokens_per_sec_64k_ctx_1chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -154,6 +154,32 @@ def test_train_step_sharded_matches_unsharded_loss():
     np.testing.assert_allclose(float(loss_1), float(loss_n), rtol=1e-4)
 
 
+def test_opt_state_sharded_like_params():
+    """Moment buffers must inherit each param's own sharding — wq and wo have
+    the same *shape* whenever q_dim == d_model but transposed layouts, so a
+    shape-keyed mapping would collide (regression test)."""
+    mesh = cpu_mesh(4, {AXIS_SEQ: 2, AXIS_MODEL: 2})
+    opt = default_optimizer()
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), CFG, opt, mesh=mesh)
+    assert CFG.q_dim == CFG.d_model  # the collision precondition
+
+    wq_spec = params["layers"]["wq"].sharding.spec
+    wo_spec = params["layers"]["wo"].sharding.spec
+    assert wq_spec != wo_spec
+    mu = opt_state[1][0].mu
+    assert mu["layers"]["wq"].sharding.spec == wq_spec
+    assert mu["layers"]["wo"].sharding.spec == wo_spec
+
+
+def test_max_seq_len_enforced():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, max_seq_len=16)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        forward(params, jnp.zeros((1, 32), jnp.int32), cfg)
+
+
 def test_gqa_heads_exercised():
     """Config uses n_kv_heads < n_heads — make sure grads reach wk/wv."""
     batch = _batch(jax.random.PRNGKey(9), B=1, T=16)

@@ -80,18 +80,34 @@ def init_train_state(
 
 
 def _sharded_opt_init(optimizer, params, mesh):
-    """optax state leaves are either param-shaped (shard like the param) or
-    scalars (replicate); derive shardings structurally from eval_shape."""
+    """Shard optimizer state like the params it mirrors.
+
+    optax moment buffers (adam mu/nu, ...) are copies of the param pytree
+    nested inside the state, so an opt-state leaf whose tree path *ends with*
+    a param's path (and matches its shape) gets that param's sharding;
+    everything else (step counts, scalars) is replicated. Matching by path
+    suffix — not by shape — keeps same-shaped params with different layouts
+    (wq vs wo whenever q_dim == d_model) on their own specs.
+    """
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
     shapes = jax.eval_shape(optimizer.init, params)
-    shape_to_sharding = {}
-    for p in jax.tree.leaves(params):
-        shape_to_sharding.setdefault(p.shape, p.sharding)
+    param_by_path = {
+        tuple(keystr((k,)) for k in path): (p.shape, p.sharding)
+        for path, p in tree_flatten_with_path(params)[0]
+    }
     replicated = NamedSharding(mesh, P())
 
-    def pick(leaf):
-        return shape_to_sharding.get(leaf.shape, replicated)
+    def pick(path, leaf):
+        keys = tuple(keystr((k,)) for k in path)
+        for i in range(len(keys)):
+            hit = param_by_path.get(keys[i:])
+            if hit is not None and hit[0] == leaf.shape:
+                return hit[1]
+        return replicated
 
-    out_shardings = jax.tree.map(pick, shapes)
+    flat, treedef = tree_flatten_with_path(shapes)
+    out_shardings = tree_unflatten(treedef, [pick(p, s) for p, s in flat])
     return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
 
 

@@ -283,6 +283,8 @@ def forward(
         return lax.with_sharding_constraint(x, NamedSharding(mesh, act_spec))
 
     T = tokens.shape[1]
+    if T > cfg.max_seq_len:
+        raise ValueError(f"sequence length {T} exceeds max_seq_len={cfg.max_seq_len}")
     positions = jnp.arange(T, dtype=jnp.int32)
     x = constrain(jnp.take(params["embed"], tokens, axis=0))
 
