@@ -10,4 +10,5 @@ from tree_attention_tpu.parallel.mesh import (  # noqa: F401
     replicate,
     shard_along,
 )
+from tree_attention_tpu.parallel.ring import ring_attention  # noqa: F401
 from tree_attention_tpu.parallel.tree import tree_attention, tree_decode  # noqa: F401

@@ -1,0 +1,164 @@
+"""Ring attention: the comparator baseline for the tree reduction.
+
+Tree attention's headline claim (BASELINE.json north star, and the paper the
+reference reimplements) is measured *against ring attention*, so the framework
+carries an honest, non-strawman ring implementation (SURVEY.md §7 hard part 4):
+Q, K, V all sequence-sharded, KV shards rotated around the mesh's ``seq`` axis
+with ``lax.ppermute`` while every device accumulates online-softmax partial
+state against its resident Q block. N-1 permute steps of O(local KV) payload
+each — the O(N) latency chain the tree merge's O(log N) collectives are
+positioned against.
+
+Not a strawman because:
+
+- the next KV block's ``ppermute`` is issued *before* the current block's
+  attention compute, so XLA's latency-hiding scheduler can overlap
+  communication with the flash kernel (the standard ring-attention trick);
+- the per-step kernel is the same :func:`flash_attention
+  <tree_attention_tpu.ops.flash_attention>` the tree path uses — both sides of
+  the benchmark run identical local math;
+- the merge is the same safe-softmax monoid, carried as running
+  ``(max, numerator, denominator)`` in float32.
+
+Differentiable end-to-end: ``ppermute`` transposes to the inverse permutation
+and the scan transposes to a reverse-order scan, so the backward pass is
+itself a ring rotation — no custom VJP needed.
+
+The reference contains no ring code (tree attention is positioned against it,
+SURVEY.md §2.4); this module exists so the benchmark's "vs ring" number is
+produced by this framework rather than assumed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.ops.reference import NEG_INF
+from tree_attention_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _merge_step(
+    m: jax.Array, num: jax.Array, den: jax.Array,
+    out_b: jax.Array, lse_b: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold one block's ``(out, lse)`` into running ``(m, num, den)`` state.
+
+    The same safe-softmax monoid as the tree merge
+    (:func:`tree_attention_tpu.ops.reference.merge_partials`), specialised to
+    a running left fold. ``m`` may be ``-inf`` (no visible keys yet) — the
+    stabilising shift is clamped to 0 there so ``exp(-inf - 0) = 0`` and the
+    empty side drops out without NaNs.
+    """
+    m_new = jnp.maximum(m, lse_b)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    alpha = jnp.exp(m - m_safe)
+    beta = jnp.exp(lse_b - m_safe)
+    num_new = num * alpha[..., None] + out_b.astype(jnp.float32) * beta[..., None]
+    den_new = den * alpha + beta
+    return m_new, num_new, den_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_position: Optional[int] = None,
+    impl: str = "auto",
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fully sequence-sharded exact attention via KV ring rotation.
+
+    Same contract and sharding as :func:`tree_attention
+    <tree_attention_tpu.parallel.tree.tree_attention>` — ``q/k/v`` of shapes
+    ``(B, Hq, T, D)`` / ``(B, Hkv, T, D)`` sharded along dim 2 over
+    ``seq_axis`` — but the communication pattern is the O(N)-step ring the
+    tree reduction is benchmarked against.
+
+    Returns:
+      ``(out, lse)`` sharded like ``q``.
+    """
+    B, Hq, Tq_global, D = q.shape
+    if q_position is None:
+        q_position = k.shape[2] - Tq_global
+    n_shards = mesh.shape[seq_axis]
+    if Tq_global % n_shards or k.shape[2] % n_shards:
+        raise ValueError(
+            f"sequence lengths (q={Tq_global}, k={k.shape[2]}) must divide "
+            f"over {n_shards} '{seq_axis}' shards"
+        )
+    Tq_local = Tq_global // n_shards
+    Tk_local = k.shape[2] // n_shards
+
+    spec = P(data_axis, head_axis, seq_axis, None)
+    lse_spec = P(data_axis, head_axis, seq_axis)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, lse_spec),
+        check_vma=False,
+    )
+    def _sharded(q_l, k_l, v_l):
+        n = lax.axis_size(seq_axis)
+        me = lax.axis_index(seq_axis)
+        # Send my block to the next device; after step j I hold the KV shard
+        # originally resident on device (me - j) mod n.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        Hq_l, Tq_l = q_l.shape[1], q_l.shape[2]
+        q_off = q_position + me * Tq_local
+
+        m0 = jnp.full((q_l.shape[0], Hq_l, Tq_l), NEG_INF, jnp.float32)
+        num0 = jnp.zeros(q_l.shape[:3] + (D,), jnp.float32)
+        den0 = jnp.zeros_like(m0)
+
+        def attend(k_cur, v_cur, step, m, num, den):
+            src = (me - step) % n
+            out_b, lse_b = flash_attention(
+                q_l, k_cur, v_cur,
+                causal=causal, scale=scale,
+                q_offset=q_off,
+                kv_offset=src * Tk_local,
+                impl=impl, block_size=block_size,
+            )
+            return _merge_step(m, num, den, out_b, lse_b)
+
+        def body(carry, step):
+            k_cur, v_cur, m, num, den = carry
+            # Issue the rotation for the *next* step first: the permute has no
+            # data dependency on this step's attention, so XLA can overlap the
+            # ICI transfer with the kernel.
+            k_nxt = lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = lax.ppermute(v_cur, seq_axis, perm)
+            m, num, den = attend(k_cur, v_cur, step, m, num, den)
+            return (k_nxt, v_nxt, m, num, den), None
+
+        # n-1 rotate-and-attend steps, then the last resident block with no
+        # trailing (wasted) permute — the ring does exactly n-1 transfers.
+        (k_last, v_last, m, num, den), _ = lax.scan(
+            body, (k_l, v_l, m0, num0, den0), jnp.arange(n - 1)
+        )
+        m, num, den = attend(k_last, v_last, n - 1, m, num, den)
+        empty = den <= 0.0
+        den_safe = jnp.where(empty, 1.0, den)
+        out = jnp.where(empty[..., None], 0.0, num / den_safe[..., None])
+        lse = jnp.where(empty, NEG_INF, m + jnp.log(den_safe))
+        return out.astype(q.dtype), lse.astype(jnp.float32)
+
+    return _sharded(q, k, v)
