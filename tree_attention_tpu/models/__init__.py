@@ -15,6 +15,13 @@ from tree_attention_tpu.models.transformer import (  # noqa: F401
     param_shardings,
     param_specs,
 )
+from tree_attention_tpu.models.decode import (  # noqa: F401
+    KVCache,
+    decode_attention,
+    forward_step,
+    generate,
+    init_cache,
+)
 from tree_attention_tpu.models.train import (  # noqa: F401
     default_optimizer,
     init_train_state,

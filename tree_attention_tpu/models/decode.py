@@ -1,0 +1,296 @@
+"""Autoregressive decoding: sharded KV cache + prefill/step/generate.
+
+BASELINE.json config 4 ("GQA decode: 1-token Q against 256k-token sharded KV
+cache") is the inference shape the reference gestures at but never builds — its
+driver decodes one token against freshly random KV and discards the result
+(``/root/reference/model.py:129-155``). This module provides the real thing:
+
+- :class:`KVCache` — a pytree of per-layer K/V buffers ``(L, B, Hkv, Tmax, D)``
+  plus a traced ``length``. Under a mesh the buffers are **sequence-sharded**
+  (``P(None, data, model, seq, None)``), so a 256k-token cache lives as
+  Tmax/N-token shards — context capacity scales with the mesh, the point of
+  tree attention.
+- :func:`forward_step` — one model step over ``Tq`` new tokens: writes their
+  K/V into the cache at ``[length, length+Tq)`` and attends causally against
+  the whole buffer. Static shapes throughout (``length`` is data, not shape):
+  one compilation serves every step. Prefill is the same function with the
+  prompt as one big step.
+- :func:`generate` — prefill + ``lax.scan`` of single-token steps, greedy or
+  temperature sampling, donate-friendly.
+
+Masking needs no separate "valid length" machinery: query ``i`` of a step sits
+at global position ``length + i`` and the causal rule ``q_pos >= k_pos``
+already hides every cache slot ``>= length`` (they are the future). Cache
+attention routes through :func:`tree_decode
+<tree_attention_tpu.parallel.tree.tree_decode>` on a sequence-parallel mesh
+(replicated Q, one pmax + one packed psum) and through :func:`flash_decode
+<tree_attention_tpu.ops.decode.flash_decode>` (split-KV) on a single device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tree_attention_tpu.models.transformer import (
+    Params,
+    TransformerConfig,
+    _heads,
+    _unheads,
+    _mlp_block,
+    rms_norm,
+    rope,
+)
+from tree_attention_tpu.ops.decode import flash_decode
+from tree_attention_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    prune_axes,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Per-layer KV buffers ``(L, B, Hkv, Tmax, D)`` and the filled length."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # () int32 — tokens written so far
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[3]
+
+
+def init_cache(
+    cfg: TransformerConfig,
+    batch_size: int,
+    max_len: int,
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = AXIS_DATA,
+    seq_axis: str = AXIS_SEQ,
+    model_axis: Optional[str] = AXIS_MODEL,
+) -> KVCache:
+    """Allocate an empty cache; sequence-sharded over ``mesh`` when given."""
+    shape = (cfg.n_layers, batch_size, cfg.n_kv_heads, max_len, cfg.d_head)
+    if mesh is not None:
+        ax = prune_axes(
+            mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
+        )
+        spec = P(None, ax["data"], ax["model"], ax["seq"], None)
+        if max_len % max(mesh.shape.get(seq_axis, 1), 1):
+            raise ValueError(
+                f"cache capacity {max_len} must divide over "
+                f"{mesh.shape.get(seq_axis, 1)} '{seq_axis}' shards"
+            )
+        sharding = NamedSharding(mesh, spec)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, cfg.dtype), out_shardings=sharding
+        )
+        k = zeros()
+        v = zeros()
+    else:
+        k = jnp.zeros(shape, cfg.dtype)
+        v = jnp.zeros(shape, cfg.dtype)
+    return KVCache(k=k, v=v, length=jnp.zeros((), jnp.int32))
+
+
+def forward_step(
+    params: Params,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = AXIS_DATA,
+    seq_axis: str = AXIS_SEQ,
+    model_axis: Optional[str] = AXIS_MODEL,
+    num_splits: Optional[int] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """Run ``Tq`` new tokens through the model against the cache.
+
+    Args:
+      tokens: ``(B, Tq)`` token ids occupying global positions
+        ``[cache.length, cache.length + Tq)``. ``Tq`` is the prompt length at
+        prefill and 1 in the decode loop — both hit the same code path.
+
+    Returns:
+      ``logits``: ``(B, Tq, vocab)`` float32; the updated cache
+      (``length += Tq``).
+    """
+    axes = prune_axes(
+        mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    )
+
+    B, Tq = tokens.shape
+    start = cache.length
+    if not isinstance(start, jax.core.Tracer) and int(start) + Tq > cache.capacity:
+        # Only checkable eagerly: under jit ``length`` is traced and an
+        # overflowing write would silently clamp (dynamic_update_slice
+        # semantics), corrupting the newest rows — callers sizing their own
+        # caches must keep length + Tq <= capacity (generate() does).
+        raise ValueError(
+            f"KV cache overflow: length {int(start)} + {Tq} new tokens "
+            f"exceeds capacity {cache.capacity}"
+        )
+    positions = start + jnp.arange(Tq, dtype=jnp.int32)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def body(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        h = rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = _heads(h @ layer["wq"], cfg.n_heads, cfg.d_head)
+        k_new = _heads(h @ layer["wk"], cfg.n_kv_heads, cfg.d_head)
+        v_new = _heads(h @ layer["wv"], cfg.n_kv_heads, cfg.d_head)
+        q = rope(q, positions, cfg.rope_theta)
+        k_new = rope(k_new, positions, cfg.rope_theta)
+
+        # Write the new rows at [start, start+Tq). Under a mesh GSPMD turns
+        # the dynamic-update into per-shard masked writes on the seq dim.
+        k_cache = lax.dynamic_update_slice_in_dim(
+            k_cache, k_new.astype(k_cache.dtype), start, axis=2
+        )
+        v_cache = lax.dynamic_update_slice_in_dim(
+            v_cache, v_new.astype(v_cache.dtype), start, axis=2
+        )
+
+        out, _ = decode_attention(
+            q, k_cache, v_cache,
+            q_position=start,
+            mesh=mesh,
+            data_axis=axes["data"],
+            seq_axis=axes["seq"],
+            model_axis=axes["model"],
+            impl=cfg.attn_impl,
+            num_splits=num_splits,
+            block_size=cfg.attn_block_size,
+        )
+        x = x + _unheads(out) @ layer["wo"]
+        x = x + _mlp_block(layer, rms_norm(x, layer["ln2"], cfg.norm_eps))
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["ln_f"], cfg.norm_eps)
+    logits = (x @ params["wout"]).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=start + Tq)
+
+
+def _sample(logits: jax.Array, temperature: float, key: Optional[jax.Array]):
+    """Greedy when temperature == 0 (static), else categorical."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    cfg: TransformerConfig,
+    *,
+    cache_len: Optional[int] = None,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = AXIS_DATA,
+    seq_axis: str = AXIS_SEQ,
+    model_axis: Optional[str] = AXIS_MODEL,
+) -> jax.Array:
+    """Prefill the prompt, then decode ``max_new_tokens`` autoregressively.
+
+    Args:
+      prompt: ``(B, Tp)`` token ids.
+      cache_len: cache capacity; defaults to ``Tp + max_new_tokens`` rounded up
+        to the mesh's seq-shard multiple.
+
+    Returns:
+      ``(B, max_new_tokens)`` sampled token ids.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    B, Tp = prompt.shape
+    total = Tp + max_new_tokens
+    if cache_len is None:
+        shards = mesh.shape.get(seq_axis, 1) if mesh is not None else 1
+        cache_len = total + (-total) % max(shards, 1)
+    if cache_len < total:
+        raise ValueError(f"cache_len={cache_len} < prompt+new={total}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    key = jax.random.PRNGKey(0) if key is None else key
+
+    kw = dict(
+        mesh=mesh, data_axis=data_axis, seq_axis=seq_axis, model_axis=model_axis
+    )
+    cache = init_cache(cfg, B, cache_len, **kw)
+    logits, cache = forward_step(params, prompt, cache, cfg, **kw)
+    key, sub = jax.random.split(key)
+    tok = _sample(logits[:, -1], temperature, sub)
+
+    def body(carry, _):
+        cache, tok, key = carry
+        logits, cache = forward_step(params, tok[:, None], cache, cfg, **kw)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits[:, -1], temperature, sub)
+        return (cache, nxt, key), tok
+
+    (_, last, _), toks = lax.scan(
+        body, (cache, tok, key), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_position=None,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = AXIS_DATA,
+    seq_axis: str = AXIS_SEQ,
+    model_axis: Optional[str] = AXIS_MODEL,
+    impl: str = "auto",
+    num_splits: Optional[int] = None,
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Op-level decode entry: split-KV on one device, tree merge on a mesh.
+
+    The two are the same algorithm at different granularity (chunks vs
+    shards); this picks by topology so callers write one line. This is the
+    single home of that dispatch rule — :func:`forward_step` routes through it.
+    """
+    ax = prune_axes(
+        mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    )
+    if mesh is not None and mesh.shape.get(ax["seq"] or "", 1) > 1:
+        from tree_attention_tpu.parallel.tree import tree_decode
+
+        return tree_decode(
+            q, k, v,
+            mesh=mesh,
+            seq_axis=ax["seq"],
+            data_axis=ax["data"],
+            head_axis=ax["model"],
+            causal=True,
+            q_position=q_position,
+            impl=impl,
+            block_size=block_size,
+        )
+    return flash_decode(
+        q, k, v, q_position=q_position, num_splits=num_splits,
+        block_size=block_size,
+    )

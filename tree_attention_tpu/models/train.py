@@ -46,12 +46,15 @@ def default_optimizer(
 
 
 def _axes_in_mesh(mesh: Optional[Mesh], data_axis, seq_axis, model_axis):
-    """Drop axis names the mesh doesn't actually carry (so one call site works
-    for 1-axis seq-only meshes and full data×seq×model meshes alike)."""
+    """Triple form of :func:`~tree_attention_tpu.parallel.mesh.prune_axes`."""
+    from tree_attention_tpu.parallel.mesh import prune_axes
+
     if mesh is None:
         return None, seq_axis, None
-    present = lambda a: a if (a is not None and a in mesh.shape) else None
-    return present(data_axis), present(seq_axis), present(model_axis)
+    ax = prune_axes(
+        mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    )
+    return ax["data"], ax["seq"], ax["model"]
 
 
 def init_train_state(

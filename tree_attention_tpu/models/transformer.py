@@ -272,9 +272,12 @@ def forward(
     the residual stream stays sequence-sharded between tree-attention calls;
     without it, this is a plain single-device forward.
     """
-    axes = {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    from tree_attention_tpu.parallel.mesh import prune_axes
+
+    axes = prune_axes(
+        mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    )
     if mesh is not None:
-        axes = {k: (a if a in mesh.shape else None) for k, a in axes.items()}
         act_spec = P(axes["data"], axes["seq"], None)
 
     def constrain(x):

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import jax
 
+from tree_attention_tpu.ops.decode import flash_decode  # noqa: F401
 from tree_attention_tpu.ops.reference import (  # noqa: F401
     attention_blockwise,
     attention_naive,

@@ -1,0 +1,93 @@
+"""Split-KV flash decode: the q_len≈1 inference shape, parallelised over KV.
+
+Decode is the reference's entire workload (``/root/reference/model.py:140-145``:
+one query token against a 64k-token KV), and it is the shape where a plain
+blockwise scan is weakest on TPU: with Tq=1 each KV block contributes one tiny
+matvec, and a sequential ``lax.scan`` over blocks serialises what is really a
+bandwidth-bound reduction. The standard fix (flash-decode / split-KV) is to
+cut KV into S independent chunks, compute per-chunk partial ``(out, lse)`` in
+parallel — XLA maps the ``vmap`` over chunks onto parallel work — and combine
+with the same safe-softmax monoid the tree reduction uses
+(:func:`~tree_attention_tpu.ops.reference.merge_partials`). The split is the
+single-device mirror of the cross-device tree merge: same math, chunks instead
+of mesh shards.
+
+Masking is uniformly causal-with-offsets: a query at global position
+``q_position + i`` sees keys at global positions ``<= q_position + i``. A
+padded or partially-filled KV buffer (a cache of capacity Tmax holding
+``length`` valid tokens) needs no separate length mask — pass
+``q_position = length - Tq`` and every slot ``>= length`` is in the masked
+future.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tree_attention_tpu.ops.block_utils import pad_to_block
+from tree_attention_tpu.ops.reference import attention_blockwise, merge_partials
+
+
+def default_num_splits(kv_len: int, block_size: int) -> int:
+    """Enough chunks to expose parallelism, never smaller than one block."""
+    return max(1, min(16, kv_len // max(block_size, 1)))
+
+
+def flash_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_position=None,
+    scale: Optional[float] = None,
+    num_splits: Optional[int] = None,
+    block_size: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Causal decode attention of a few new queries against a long KV buffer.
+
+    Args:
+      q: ``(B, Hq, Tq, D)`` — the new tokens' queries (Tq is typically 1;
+        a few for speculative/chunked decode).
+      k, v: ``(B, Hkv, Tk, D)`` KV buffer; only positions ``<= q_position + i``
+        are visible to query ``i``, so a cache longer than the valid prefix is
+        handled by ``q_position`` alone.
+      q_position: global position of the first query row. Defaults to
+        ``Tk - Tq`` (queries are the newest tokens of a fully-valid buffer).
+        May be a traced scalar — decode steps jit once and run at every
+        sequence length.
+      num_splits: KV chunks computed in parallel; default scales with
+        ``Tk / block_size`` (capped at 16).
+
+    Returns:
+      ``(out, lse)``: ``(B, Hq, Tq, D)`` in q's dtype, ``(B, Hq, Tq)`` float32.
+    """
+    B, Hq, Tq, D = q.shape
+    Tk = k.shape[2]
+    if q_position is None:
+        q_position = Tk - Tq
+    S = num_splits if num_splits is not None else default_num_splits(Tk, block_size)
+    S = max(1, min(S, Tk))
+    chunk = -(-Tk // S)  # ceil
+
+    # Pad to S equal chunks; padded slots sit at global positions >= Tk, in
+    # every query's masked future, so the causal mask removes them exactly.
+    kp = pad_to_block(k, 2, chunk)
+    vp = pad_to_block(v, 2, chunk)
+    S = kp.shape[2] // chunk
+    kb = kp.reshape(B, k.shape[1], S, chunk, D).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(B, v.shape[1], S, chunk, D).transpose(2, 0, 1, 3, 4)
+    offsets = jnp.arange(S) * chunk
+
+    def one_chunk(k_s: jax.Array, v_s: jax.Array, off: jax.Array):
+        return attention_blockwise(
+            q, k_s, v_s,
+            causal=True, scale=scale,
+            q_offset=q_position, kv_offset=off,
+            block_size=min(block_size, chunk),
+        )
+
+    outs, lses = jax.vmap(one_chunk)(kb, vb, offsets)
+    return merge_partials(outs, lses)

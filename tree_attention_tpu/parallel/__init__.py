@@ -7,6 +7,7 @@ from tree_attention_tpu.parallel.mesh import (  # noqa: F401
     cpu_mesh,
     initialize_distributed,
     make_mesh,
+    prune_axes,
     replicate,
     shard_along,
 )

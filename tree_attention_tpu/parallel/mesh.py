@@ -109,6 +109,22 @@ def cpu_mesh(n: int, axes: Optional[Mapping[str, int]] = None) -> Mesh:
     return make_mesh(axes or {AXIS_SEQ: n}, devices=cpus[:n])
 
 
+def prune_axes(mesh: Optional[Mesh], axes: Mapping[str, Optional[str]]) -> dict:
+    """Drop axis names the mesh doesn't carry (name -> None).
+
+    The one definition of the rule every sharded entry point applies to its
+    ``data/seq/model`` keyword axes, so a seq-only mesh and a full
+    data×seq×model mesh work through identical call sites. With no mesh the
+    axes pass through unchanged (they are only consulted when a mesh exists).
+    """
+    if mesh is None:
+        return dict(axes)
+    return {
+        k: (a if a is not None and a in mesh.shape else None)
+        for k, a in axes.items()
+    }
+
+
 def shard_along(mesh: Mesh, x: jax.Array, axis_name: str, dim: int) -> jax.Array:
     """Place ``x`` with dimension ``dim`` sharded over mesh axis ``axis_name``."""
     spec = [None] * x.ndim
