@@ -15,12 +15,12 @@ is decode KV-tokens/sec and vs_baseline is the speedup over the reference's
 """
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
 
 from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.utils.profiling import time_fn
 
 B, H, D, T = 1, 16, 128, 64000
 BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
@@ -40,15 +40,8 @@ def main() -> None:
     jax.block_until_ready((out, lse))
     assert out.shape == (B, H, 1, D) and lse.shape == (B, H, 1)
 
-    iters = 50
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(q, k, v))
-        times.append(time.perf_counter() - t0)
-    dt = sorted(times)[len(times) // 2]  # median
-
-    tokens_per_sec = T / dt
+    stats = time_fn(fn, q, k, v, iters=50, warmup=1)
+    tokens_per_sec = stats.tokens_per_sec(T)
     print(
         json.dumps(
             {

@@ -1,0 +1,121 @@
+"""Utils layer: config parsing, logging sinks, fenced timing."""
+
+import json
+import logging
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from tree_attention_tpu.utils import (
+    RunConfig,
+    TimingStats,
+    device_memory_stats,
+    get_logger,
+    parse_args,
+    parse_mesh_spec,
+    setup_logging,
+    time_fn,
+    trace,
+)
+
+
+class TestConfig:
+    def test_defaults_reproduce_reference_workload(self):
+        # /root/reference/model.py:140-145,51-53 — seq 64000, 16 heads,
+        # head_dim 128, B=1, single-query decode.
+        cfg = parse_args([])
+        assert (cfg.seq_len, cfg.heads, cfg.head_dim, cfg.batch, cfg.q_len) == (
+            64000, 16, 128, 1, 1,
+        )
+        assert cfg.mode == "decode" and not cfg.causal
+
+    def test_flags_roundtrip(self):
+        cfg = parse_args(
+            "--mode bench --seq-len 4096 --heads 8 --kv-heads 2 --head-dim 64 "
+            "--causal --dtype float32 --mesh data=2,seq=4 --comparator ring "
+            "--impl blockwise --iters 3".split()
+        )
+        assert cfg.mode == "bench" and cfg.seq_len == 4096
+        assert cfg.resolved_kv_heads() == 2 and cfg.causal
+        assert cfg.mesh_axes() == {"data": 2, "seq": 4}
+        assert cfg.comparator == "ring" and cfg.iters == 3
+
+    def test_kv_heads_default_is_mha(self):
+        assert RunConfig(heads=12).resolved_kv_heads() == 12
+
+    def test_mesh_spec_errors(self):
+        with pytest.raises(ValueError):
+            parse_mesh_spec("seq")
+        with pytest.raises(ValueError):
+            parse_mesh_spec("seq=2,seq=4")
+        with pytest.raises(ValueError):
+            parse_mesh_spec("")
+        assert parse_mesh_spec("data=2, seq=-1") == {"data": 2, "seq": -1}
+
+
+class TestLogging:
+    def test_process_prefix_and_file_sink(self, tmp_path):
+        log = tmp_path / "run.log"
+        setup_logging(logging.DEBUG, log_file=str(log))
+        get_logger("kernel").info("block %d done", 7)
+        text = log.read_text()
+        assert "[p0]" in text and "block 7 done" in text
+        assert "tree_attention_tpu.kernel" in text
+
+    def test_nonzero_process_clamped_to_warning(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("JAX_PROCESS_INDEX", "3")
+        # jax is imported in this test process, so fake its process_index too.
+        import jax
+
+        monkeypatch.setattr(jax, "process_index", lambda: 3)
+        log = tmp_path / "p3.log"
+        setup_logging(logging.INFO, log_file=str(log))
+        get_logger().info("chatty")
+        get_logger().warning("important")
+        text = log.read_text()
+        assert "chatty" not in text and "important" in text
+        assert "[p3]" in text
+
+    def test_setup_idempotent(self):
+        r1 = setup_logging()
+        r2 = setup_logging()
+        assert r1 is r2 and len(r2.handlers) == 1
+
+
+class TestProfiling:
+    def test_time_fn_stats(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return jnp.asarray(x) * 2
+
+        stats = time_fn(f, 3, iters=4, warmup=1)
+        assert isinstance(stats, TimingStats)
+        assert stats.iters == 4 and len(calls) == 5
+        assert stats.minimum <= stats.median <= stats.maximum
+        assert stats.tokens_per_sec(1000) == 1000 / stats.median
+        assert set(stats.as_dict()) == {
+            "median_s", "mean_s", "min_s", "max_s", "iters",
+        }
+        json.dumps(stats.as_dict())  # JSON-serialisable for bench records
+
+    def test_time_fn_rejects_zero_iters(self):
+        with pytest.raises(ValueError):
+            time_fn(lambda: None, iters=0)
+
+    def test_memory_stats_none_or_dict(self):
+        stats = device_memory_stats()
+        assert stats is None or (
+            isinstance(stats, dict)
+            and all(isinstance(v, int) for v in stats.values())
+        )
+
+    def test_trace_noop_and_capture(self, tmp_path):
+        with trace(None):
+            pass
+        d = tmp_path / "prof"
+        with trace(str(d)):
+            jnp.ones((4,)).sum().block_until_ready()
+        assert os.path.isdir(d)

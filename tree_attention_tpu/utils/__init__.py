@@ -1,0 +1,31 @@
+"""Cross-cutting utilities: logging, config/flags, profiling & timing.
+
+The reference's cross-cutting layer is loguru sprinkled through every function
+plus a rotating file sink (``/root/reference/model.py:160``) and a hardcoded
+problem size (``model.py:140-145``) with no flag system at all (SURVEY.md §5).
+Here those become three real modules:
+
+- :mod:`.logging`   — structured stdlib logging, per-process prefixes,
+  process-0-only default, optional rotating file sink.
+- :mod:`.config`    — one dataclass config + argparse bridge; defaults
+  reproduce the reference's hardcoded run.
+- :mod:`.profiling` — fenced timing (``block_until_ready``), device memory
+  stats (peak HBM), and ``jax.profiler`` trace capture.
+"""
+
+from tree_attention_tpu.utils.config import (  # noqa: F401
+    RunConfig,
+    build_arg_parser,
+    parse_args,
+    parse_mesh_spec,
+)
+from tree_attention_tpu.utils.logging import (  # noqa: F401
+    get_logger,
+    setup_logging,
+)
+from tree_attention_tpu.utils.profiling import (  # noqa: F401
+    TimingStats,
+    device_memory_stats,
+    time_fn,
+    trace,
+)

@@ -1,0 +1,136 @@
+"""Config/flag system: one dataclass, one argparse bridge.
+
+The reference has no config system at all — problem size is hardcoded at
+``/root/reference/model.py:140-145``, rendezvous at ``model.py:20-21``, dtype
+and seed inside ``make_data`` (``model.py:50-53``). SURVEY.md §5 mandates a
+dataclass + flags whose **defaults reproduce the reference run**:
+seq_len=64000, 16 heads, head_dim=128, B=1, q_len=1 decode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse ``"seq=8"`` / ``"data=2,seq=2,model=2"`` into an ordered axis map.
+
+    A size of -1 absorbs remaining devices (see ``mesh.make_mesh``).
+    """
+    axes: Dict[str, int] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            raise ValueError(f"bad mesh axis {part!r}; want name=size")
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name in axes:
+            raise ValueError(f"duplicate mesh axis {name!r}")
+        axes[name] = int(size)
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return axes
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Everything the driver needs; field defaults == the reference workload."""
+
+    # Problem size (reference: model.py:140-145, 51-53).
+    batch: int = 1
+    seq_len: int = 64000
+    q_len: int = 1
+    heads: int = 16
+    kv_heads: Optional[int] = None  # None → MHA (kv_heads == heads)
+    head_dim: int = 128
+    causal: bool = False
+    dtype: str = "bfloat16"  # TPU-native half; reference used fp16 on CPU
+
+    # Execution.
+    mode: str = "decode"  # decode | train | generate | bench
+    device: str = "auto"  # auto | tpu | cpu
+    mesh: Optional[str] = None  # e.g. "seq=8" or "data=2,seq=2,model=2"
+    n_virtual_cpu: int = 0  # >0: force N virtual CPU devices (tests/emulation)
+    impl: str = "auto"  # auto | naive | blockwise | pallas
+    block_size: int = 512
+    seed: int = 0
+
+    # Timing / bench.
+    iters: int = 10
+    warmup: int = 2
+    comparator: str = "none"  # none | ring (bench mode)
+
+    # Training mode.
+    steps: int = 3
+    model_dim: int = 256
+    n_layers: int = 2
+    vocab_size: int = 4096
+
+    # Observability.
+    log_level: str = "info"
+    log_file: Optional[str] = None
+    all_processes: bool = False
+    profile_dir: Optional[str] = None
+
+    def mesh_axes(self) -> Optional[Dict[str, int]]:
+        return parse_mesh_spec(self.mesh) if self.mesh else None
+
+    def resolved_kv_heads(self) -> int:
+        return self.heads if self.kv_heads is None else self.kv_heads
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    d = RunConfig()
+    p = argparse.ArgumentParser(
+        prog="tree_attention_tpu",
+        description=(
+            "TPU-native sequence-parallel tree attention driver. With no "
+            "flags, reproduces the reference workload (decode over a "
+            f"{d.seq_len}-token context, {d.heads} heads × {d.head_dim})."
+        ),
+    )
+    p.add_argument("--mode", choices=["decode", "train", "generate", "bench"],
+                   default=d.mode)
+    p.add_argument("--device", choices=["auto", "tpu", "cpu"], default=d.device)
+    p.add_argument("--mesh", default=d.mesh, metavar="SPEC",
+                   help="named mesh axes, e.g. seq=8 or data=2,seq=2,model=2")
+    p.add_argument("--n-virtual-cpu", type=int, default=d.n_virtual_cpu,
+                   metavar="N", help="emulate N CPU devices (forces --device=cpu)")
+    p.add_argument("--batch", type=int, default=d.batch)
+    p.add_argument("--seq-len", type=int, default=d.seq_len)
+    p.add_argument("--q-len", type=int, default=d.q_len)
+    p.add_argument("--heads", type=int, default=d.heads)
+    p.add_argument("--kv-heads", type=int, default=d.kv_heads,
+                   help="GQA KV head count (default: same as --heads)")
+    p.add_argument("--head-dim", type=int, default=d.head_dim)
+    p.add_argument("--causal", action="store_true", default=d.causal)
+    p.add_argument("--dtype", choices=["bfloat16", "float16", "float32"],
+                   default=d.dtype)
+    p.add_argument("--impl", choices=["auto", "naive", "blockwise", "pallas"],
+                   default=d.impl)
+    p.add_argument("--block-size", type=int, default=d.block_size)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--iters", type=int, default=d.iters)
+    p.add_argument("--warmup", type=int, default=d.warmup)
+    p.add_argument("--comparator", choices=["none", "ring"], default=d.comparator,
+                   help="bench mode: also run a comparator and report the ratio")
+    p.add_argument("--steps", type=int, default=d.steps, help="train-mode steps")
+    p.add_argument("--model-dim", type=int, default=d.model_dim)
+    p.add_argument("--n-layers", type=int, default=d.n_layers)
+    p.add_argument("--vocab-size", type=int, default=d.vocab_size)
+    p.add_argument("--log-level", choices=["debug", "info", "warning", "error"],
+                   default=d.log_level)
+    p.add_argument("--log-file", default=d.log_file,
+                   help="rotating file sink (the reference's tree_attention_log.log)")
+    p.add_argument("--all-processes", action="store_true", default=d.all_processes,
+                   help="log from every host, not just process 0")
+    p.add_argument("--profile-dir", default=d.profile_dir,
+                   help="capture a jax.profiler trace into this directory")
+    return p
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> RunConfig:
+    ns = build_arg_parser().parse_args(argv)
+    fields = {f.name for f in dataclasses.fields(RunConfig)}
+    return RunConfig(**{k: v for k, v in vars(ns).items() if k in fields})

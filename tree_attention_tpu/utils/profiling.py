@@ -1,0 +1,96 @@
+"""Profiling & timing: honest numbers on an async dispatch runtime.
+
+The reference's entire observability story is one ``time.time()`` pair around
+a single call (``/root/reference/model.py:149-153``) — which on an async
+runtime like JAX would time the *dispatch*, not the work. Here every timing
+fences with ``jax.block_until_ready`` and reports robust statistics, device
+memory stats expose peak HBM, and ``trace`` wraps ``jax.profiler`` capture
+(TensorBoard/Perfetto) as SURVEY.md §5 mandates.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+
+
+@dataclasses.dataclass
+class TimingStats:
+    """Per-call wall-clock stats over ``iters`` fenced repetitions, seconds."""
+
+    median: float
+    mean: float
+    minimum: float
+    maximum: float
+    iters: int
+    times: Sequence[float]
+
+    def tokens_per_sec(self, tokens: int) -> float:
+        return tokens / self.median
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "median_s": self.median,
+            "mean_s": self.mean,
+            "min_s": self.minimum,
+            "max_s": self.maximum,
+            "iters": self.iters,
+        }
+
+
+def time_fn(
+    fn: Callable[..., Any],
+    *args: Any,
+    iters: int = 10,
+    warmup: int = 2,
+    **kwargs: Any,
+) -> TimingStats:
+    """Time ``fn(*args, **kwargs)`` with compile warmup and result fencing."""
+    if iters < 1:
+        raise ValueError(f"iters must be >= 1, got {iters}")
+    for _ in range(max(warmup, 0)):
+        jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    return TimingStats(
+        median=statistics.median(times),
+        mean=statistics.fmean(times),
+        minimum=min(times),
+        maximum=max(times),
+        iters=iters,
+        times=tuple(times),
+    )
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> Optional[Dict[str, int]]:
+    """Allocator stats for one device (peak HBM lives in ``peak_bytes_in_use``).
+
+    Returns None on backends without memory stats (e.g. CPU).
+    """
+    if device is None:
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items() if isinstance(v, (int, float))}
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler`` trace capture; no-op when ``log_dir`` is falsy."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
