@@ -1,0 +1,11 @@
+"""``python -m tree_attention_tpu`` — the driver entrypoint.
+
+The reference is run as ``python3 model.py`` (``/root/reference/README.md:13``);
+this is that surface, with flags (see :mod:`tree_attention_tpu.cli`).
+"""
+
+import sys
+
+from tree_attention_tpu.cli import main
+
+sys.exit(main())

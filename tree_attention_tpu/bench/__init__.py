@@ -1,0 +1,17 @@
+"""Benchmark harness: the numbers the reference never produced.
+
+The reference publishes no benchmarks (SURVEY.md §6) and its only measurement
+is one un-fenced wall-clock pair (``/root/reference/model.py:149-153``). This
+package is the deliverable BASELINE.md calls for: fenced tokens/sec, achieved
+FLOP/s, peak HBM, and the tree-vs-ring comparator behind the north-star
+"≥2× ring attention" claim.
+"""
+
+from tree_attention_tpu.bench.harness import (  # noqa: F401
+    BenchResult,
+    attention_flops,
+    bench_compare,
+    bench_decode,
+    bench_train_attention,
+    run_bench,
+)

@@ -1,0 +1,243 @@
+"""Parameterised attention benchmarks over the framework's real entry points.
+
+Every benchmark: generates data with the data layer (shard-local when a mesh
+is given), jits the measured function, times it with compile warmup and
+``block_until_ready`` fencing (:func:`tree_attention_tpu.utils.time_fn`), and
+reports a JSON-serialisable :class:`BenchResult` carrying tokens/sec, achieved
+FLOP/s, and peak HBM where the backend exposes allocator stats.
+
+The comparator pair (:func:`bench_compare`) runs :func:`tree_attention
+<tree_attention_tpu.parallel.tree_attention>` and :func:`ring_attention
+<tree_attention_tpu.parallel.ring.ring_attention>` on identical data, shapes,
+mesh and inner kernel, so the reported ratio isolates the communication
+pattern — the honest-comparator requirement of SURVEY.md §7 hard part 4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tree_attention_tpu.data import make_qkv, make_qkv_sharded
+from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.parallel.mesh import AXIS_SEQ, prune_axes
+from tree_attention_tpu.parallel.ring import ring_attention
+from tree_attention_tpu.parallel.tree import tree_attention, tree_decode
+from tree_attention_tpu.utils.config import RunConfig
+from tree_attention_tpu.utils.logging import get_logger
+from tree_attention_tpu.utils.profiling import TimingStats, device_memory_stats, time_fn
+
+log = get_logger("bench")
+
+
+@dataclasses.dataclass
+class BenchResult:
+    """One benchmark record; ``as_json_line()`` is the driver-facing format."""
+
+    name: str
+    workload: Dict[str, Any]
+    timing: TimingStats
+    tokens_per_sec: float
+    flops_per_sec: float
+    n_devices: int = 1
+    peak_hbm_bytes: Optional[int] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {
+            "name": self.name,
+            "workload": self.workload,
+            "tokens_per_sec": round(self.tokens_per_sec, 1),
+            "tokens_per_sec_per_device": round(
+                self.tokens_per_sec / self.n_devices, 1
+            ),
+            "flops_per_sec": self.flops_per_sec,
+            "n_devices": self.n_devices,
+            **self.timing.as_dict(),
+        }
+        if self.peak_hbm_bytes is not None:
+            d["peak_hbm_bytes"] = self.peak_hbm_bytes
+        d.update(self.extra)
+        return d
+
+    def as_json_line(self) -> str:
+        return json.dumps(self.as_dict())
+
+
+def attention_flops(
+    *,
+    batch: int,
+    heads: int,
+    q_len: int,
+    kv_len: int,
+    head_dim: int,
+    causal: bool = False,
+    backward: bool = False,
+) -> float:
+    """Model FLOPs of exact attention: 2 matmuls, 2 FLOPs per MAC.
+
+    Causal halves the score matrix only in the square training shape (decode's
+    single query attends to everything regardless). Backward adds the standard
+    flash-attention recompute factor: dQ, dK, dV are each one QK^T-sized
+    matmul pair plus the forward recompute ⇒ ~2.5× the forward FLOPs, total
+    3.5× when ``backward``.
+    """
+    pairs = batch * heads * q_len * kv_len
+    if causal and q_len == kv_len:
+        pairs = batch * heads * (q_len * (q_len + 1)) // 2
+    fwd = 4.0 * pairs * head_dim
+    return fwd * 3.5 if backward else fwd
+
+
+def _peak_hbm() -> Optional[int]:
+    stats = device_memory_stats()
+    return stats.get("peak_bytes_in_use") if stats else None
+
+
+def _workload(cfg: RunConfig, **extra: Any) -> Dict[str, Any]:
+    return {
+        "batch": cfg.batch,
+        "heads": cfg.heads,
+        "kv_heads": cfg.resolved_kv_heads(),
+        "head_dim": cfg.head_dim,
+        "seq_len": cfg.seq_len,
+        "q_len": cfg.q_len,
+        "dtype": cfg.dtype,
+        "causal": cfg.causal,
+        "impl": cfg.impl,
+        **extra,
+    }
+
+
+def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
+    """One decode step over a ``seq_len`` KV cache; tree-merged on a mesh.
+
+    The reference's workload (``/root/reference/model.py:140-155``) with the
+    measurement done right: fenced, repeated, median.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    key = jax.random.PRNGKey(cfg.seed)
+    kw = dict(
+        batch=cfg.batch, heads=cfg.heads, kv_heads=cfg.resolved_kv_heads(),
+        q_len=cfg.q_len, seq_len=cfg.seq_len, head_dim=cfg.head_dim,
+        dtype=dtype,
+    )
+    if mesh is None:
+        q, k, v = make_qkv(key, **kw)
+        fn = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=cfg.causal, impl=cfg.impl,
+            block_size=cfg.block_size,
+        )[0])
+        n_devices = 1
+    else:
+        q, k, v = make_qkv_sharded(key, mesh, **kw)
+        axes = prune_axes(mesh, {"data": "data", "model": "model"})
+
+        def _decode(q, k, v):
+            return tree_decode(
+                q, k, v, mesh=mesh, causal=cfg.causal, impl=cfg.impl,
+                block_size=cfg.block_size,
+                data_axis=axes["data"], head_axis=axes["model"],
+            )[0]
+
+        fn = jax.jit(_decode)
+        n_devices = mesh.size
+    stats = time_fn(fn, q, k, v, iters=cfg.iters, warmup=cfg.warmup)
+    flops = attention_flops(
+        batch=cfg.batch, heads=cfg.heads, q_len=cfg.q_len, kv_len=cfg.seq_len,
+        head_dim=cfg.head_dim, causal=cfg.causal,
+    )
+    return BenchResult(
+        name="decode" if mesh is None else "tree_decode",
+        workload=_workload(cfg, mesh=None if mesh is None else dict(mesh.shape)),
+        timing=stats,
+        tokens_per_sec=cfg.seq_len / stats.median,  # KV tokens scanned per step
+        flops_per_sec=flops / stats.median,
+        n_devices=n_devices,
+        peak_hbm_bytes=_peak_hbm(),
+    )
+
+
+def _train_shape_fn(
+    cfg: RunConfig, mesh: Mesh, algorithm: str
+) -> Callable[..., Any]:
+    attn = {"tree": tree_attention, "ring": ring_attention}[algorithm]
+    axes = prune_axes(mesh, {"data": "data", "model": "model"})
+
+    def loss(q, k, v):
+        out, _ = attn(
+            q, k, v, mesh=mesh, causal=cfg.causal, impl=cfg.impl,
+            block_size=cfg.block_size,
+            data_axis=axes["data"], head_axis=axes["model"],
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def step(q, k, v):
+        _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return grads
+
+    return jax.jit(step)
+
+
+def bench_train_attention(
+    cfg: RunConfig, mesh: Mesh, algorithm: str = "tree"
+) -> BenchResult:
+    """Training-shape fwd+bwd: Q/K/V all sequence-sharded (q_len = seq_len)."""
+    dtype = jnp.dtype(cfg.dtype)
+    q, k, v = make_qkv_sharded(
+        jax.random.PRNGKey(cfg.seed), mesh,
+        batch=cfg.batch, heads=cfg.heads, kv_heads=cfg.resolved_kv_heads(),
+        q_len=cfg.seq_len, seq_len=cfg.seq_len, head_dim=cfg.head_dim,
+        dtype=dtype,
+    )
+    # Q must be sharded like KV in the training shape; make_qkv_sharded
+    # replicates Q, so re-place it along the seq axis.
+    from tree_attention_tpu.parallel.mesh import shard_along
+
+    q = shard_along(mesh, q, AXIS_SEQ, 2)
+    fn = _train_shape_fn(cfg, mesh, algorithm)
+    stats = time_fn(fn, q, k, v, iters=cfg.iters, warmup=cfg.warmup)
+    flops = attention_flops(
+        batch=cfg.batch, heads=cfg.heads, q_len=cfg.seq_len,
+        kv_len=cfg.seq_len, head_dim=cfg.head_dim, causal=cfg.causal,
+        backward=True,
+    )
+    return BenchResult(
+        name=f"{algorithm}_attention_fwd_bwd",
+        workload=_workload(cfg, q_len=cfg.seq_len, mesh=dict(mesh.shape)),
+        timing=stats,
+        tokens_per_sec=cfg.batch * cfg.seq_len / stats.median,
+        flops_per_sec=flops / stats.median,
+        n_devices=mesh.size,
+        peak_hbm_bytes=_peak_hbm(),
+    )
+
+
+def bench_compare(cfg: RunConfig, mesh: Mesh) -> Dict[str, Any]:
+    """Tree vs ring on identical data/mesh/kernel; the north-star ratio."""
+    tree = bench_train_attention(cfg, mesh, "tree")
+    ring = bench_train_attention(cfg, mesh, "ring")
+    ratio = ring.timing.median / tree.timing.median
+    log.info(
+        "tree %.4fs vs ring %.4fs per step -> tree is %.2fx ring",
+        tree.timing.median, ring.timing.median, ratio,
+    )
+    return {
+        "tree": tree.as_dict(),
+        "ring": ring.as_dict(),
+        "tree_speedup_vs_ring": round(ratio, 3),
+    }
+
+
+def run_bench(cfg: RunConfig, mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+    """Dispatch on the config; returns the record the CLI prints as JSON."""
+    if cfg.comparator == "ring":
+        if mesh is None:
+            raise ValueError("the ring comparator needs a mesh (--mesh seq=N)")
+        return bench_compare(cfg, mesh)
+    return bench_decode(cfg, mesh).as_dict()

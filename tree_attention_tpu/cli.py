@@ -1,0 +1,226 @@
+"""CLI driver: the reference's ``python3 model.py``, grown into a real tool.
+
+The reference entrypoint (``/root/reference/model.py:129-169``) hardcodes one
+workload, times one un-fenced call, and prints nothing checkable. Here
+``python -m tree_attention_tpu`` with no flags reproduces that workload —
+single-query decode over a 64k-token context, 16 heads × 128 — but measured
+honestly (fenced, repeated, median) and steered by real flags (SURVEY.md §5):
+
+    python -m tree_attention_tpu                       # reference workload
+    python -m tree_attention_tpu --mesh seq=4          # sequence-parallel
+    python -m tree_attention_tpu --device cpu --n-virtual-cpu 8 --mesh seq=8
+    python -m tree_attention_tpu --mode train --seq-len 2048 --mesh seq=4
+    python -m tree_attention_tpu --mode bench --comparator ring ...
+    python -m tree_attention_tpu --mode generate --seq-len 128
+
+Modes: ``decode`` (one attention step over a KV cache), ``train`` (LM steps on
+the flagship transformer), ``generate`` (prefill + autoregressive decode),
+``bench`` (the harness; prints one JSON record on stdout).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from typing import Optional
+
+from tree_attention_tpu.utils.config import RunConfig, parse_args
+from tree_attention_tpu.utils.logging import get_logger, setup_logging
+
+log = get_logger("cli")
+
+
+def _configure_backend(cfg: RunConfig) -> None:
+    """Pick the platform before any JAX backend initialises.
+
+    Must run before the first device query. ``--n-virtual-cpu`` implies the
+    CPU platform (the virtual-device flag only affects the CPU client). The
+    config API is used as well as the env var because TPU plugins (e.g. the
+    axon platform) can override ``JAX_PLATFORMS`` from the environment.
+    """
+    device = cfg.device
+    if cfg.n_virtual_cpu > 0:
+        device = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{cfg.n_virtual_cpu}"
+            ).strip()
+    import jax
+
+    if device != "auto":
+        jax.config.update("jax_platforms", device)
+
+
+def _build_mesh(cfg: RunConfig):
+    from tree_attention_tpu.parallel.mesh import make_mesh
+
+    axes = cfg.mesh_axes()
+    if axes is None:
+        return None
+    return make_mesh(axes)
+
+
+def _dtype(cfg: RunConfig):
+    import jax.numpy as jnp
+
+    return jnp.dtype(cfg.dtype)
+
+
+def _run_decode(cfg: RunConfig, mesh) -> int:
+    """The reference workload: one decode step, timed; parity with
+    ``main()`` at ``/root/reference/model.py:129-155``."""
+    import jax
+
+    from tree_attention_tpu.bench.harness import bench_decode
+
+    res = bench_decode(cfg, mesh)
+    log.info(
+        "decode: %d KV tokens, %d heads x %d, %s, %d device(s)",
+        cfg.seq_len, cfg.heads, cfg.head_dim, cfg.dtype,
+        1 if mesh is None else mesh.size,
+    )
+    log.info(
+        "median %.4fs per step  (%.0f KV tokens/s, %.2e FLOP/s)",
+        res.timing.median, res.tokens_per_sec, res.flops_per_sec,
+    )
+    if res.peak_hbm_bytes:
+        log.info("peak HBM: %.1f MiB", res.peak_hbm_bytes / 2**20)
+    print(res.as_json_line())
+    return 0
+
+
+def _run_bench(cfg: RunConfig, mesh) -> int:
+    from tree_attention_tpu.bench.harness import run_bench
+
+    record = run_bench(cfg, mesh)
+    print(json.dumps(record))
+    return 0
+
+
+def _transformer_config(cfg: RunConfig):
+    import jax.numpy as jnp
+
+    from tree_attention_tpu.models import TransformerConfig
+
+    d_head = cfg.model_dim // cfg.heads
+    return TransformerConfig(
+        vocab_size=cfg.vocab_size,
+        d_model=cfg.model_dim,
+        n_layers=cfg.n_layers,
+        n_heads=cfg.heads,
+        n_kv_heads=cfg.resolved_kv_heads(),
+        d_head=d_head,
+        d_ff=int(8 * cfg.model_dim / 3 + 127) // 128 * 128,
+        max_seq_len=max(cfg.seq_len, 128),
+        dtype=_dtype(cfg),
+        attn_impl=cfg.impl,
+        attn_block_size=cfg.block_size,
+    )
+
+
+def _run_train(cfg: RunConfig, mesh) -> int:
+    """LM training steps on the flagship model (the capability the reference
+    lacks entirely — no loss, no backward, no optimizer)."""
+    import jax
+
+    from tree_attention_tpu.data import make_lm_batch
+    from tree_attention_tpu.models import (
+        count_params, default_optimizer, init_train_state, make_train_step,
+    )
+    from tree_attention_tpu.utils.profiling import time_fn
+
+    tcfg = _transformer_config(cfg)
+    opt = default_optimizer()
+    state = init_train_state(jax.random.PRNGKey(cfg.seed), tcfg, opt, mesh=mesh)
+    step = make_train_step(tcfg, opt, mesh=mesh)
+    log.info(
+        "transformer: %d params, %d layers, d_model %d, seq %d",
+        count_params(state[0]), tcfg.n_layers, tcfg.d_model, cfg.seq_len,
+    )
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    losses = []
+    for i in range(cfg.steps):
+        batch = make_lm_batch(
+            jax.random.fold_in(key, i), batch=cfg.batch, seq_len=cfg.seq_len,
+            vocab_size=tcfg.vocab_size, mesh=mesh,
+        )
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+        log.info("step %d: loss %.4f", i, losses[-1])
+    # Throughput of the compiled step (last batch, post-compile). A separate
+    # non-donating step: timing re-runs with the same state, so its buffers
+    # must survive the call.
+    step_t = make_train_step(tcfg, opt, mesh=mesh, donate=False)
+    stats = time_fn(step_t, state, batch, iters=max(cfg.iters, 1), warmup=1)
+    toks = cfg.batch * cfg.seq_len
+    log.info(
+        "train step: median %.4fs (%.0f tokens/s)",
+        stats.median, toks / stats.median,
+    )
+    print(json.dumps({
+        "mode": "train",
+        "losses": losses,
+        "tokens_per_sec": round(toks / stats.median, 1),
+        **stats.as_dict(),
+    }))
+    return 0
+
+
+def _run_generate(cfg: RunConfig, mesh) -> int:
+    import jax
+
+    from tree_attention_tpu.models import generate, init_params
+
+    tcfg = _transformer_config(cfg)
+    params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(cfg.seed + 1), (cfg.batch, max(cfg.q_len, 1)),
+        0, tcfg.vocab_size,
+    )
+    n_new = min(cfg.seq_len, 32)
+    toks = generate(
+        params, prompt, n_new, tcfg,
+        temperature=0.8, key=jax.random.PRNGKey(cfg.seed + 2), mesh=mesh,
+    )
+    toks = jax.block_until_ready(toks)
+    log.info("generated %s tokens from a %s prompt", toks.shape, prompt.shape)
+    print(json.dumps({"mode": "generate", "tokens": toks.tolist()}))
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    cfg = parse_args(argv)
+    setup_logging(
+        getattr(logging, cfg.log_level.upper()),
+        log_file=cfg.log_file,
+        all_processes=cfg.all_processes,
+    )
+    _configure_backend(cfg)
+
+    import jax
+
+    from tree_attention_tpu.parallel.mesh import initialize_distributed
+    from tree_attention_tpu.utils.profiling import trace
+
+    initialize_distributed()
+    log.info(
+        "backend=%s devices=%d mesh=%s mode=%s",
+        jax.default_backend(), jax.device_count(), cfg.mesh or "none", cfg.mode,
+    )
+    mesh = _build_mesh(cfg)
+    runner = {
+        "decode": _run_decode,
+        "train": _run_train,
+        "generate": _run_generate,
+        "bench": _run_bench,
+    }[cfg.mode]
+    with trace(cfg.profile_dir):
+        return runner(cfg, mesh)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

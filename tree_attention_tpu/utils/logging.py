@@ -26,12 +26,21 @@ _FORMAT = "%(asctime)s %(levelname).1s %(process_prefix)s %(name)s: %(message)s"
 
 
 class _ProcessPrefixFilter(logging.Filter):
-    """Stamps each record with the JAX process index without forcing JAX to
-    initialise at import time (``jax.process_index()`` would start the
-    backend; env inspection keeps logging usable before/without devices)."""
+    """Stamps each record with the JAX process index and enforces the
+    process-0-only level clamp **per record**, so the rank decision is made
+    with whatever information exists at emit time — before distributed init
+    every host looks like rank 0 (fail-open), afterwards non-zero hosts are
+    clamped to WARNING without any re-setup call."""
+
+    def __init__(self, clamp_nonzero: bool):
+        super().__init__()
+        self.clamp_nonzero = clamp_nonzero
 
     def filter(self, record: logging.LogRecord) -> bool:
-        record.process_prefix = f"[p{_process_index()}]"
+        idx = _process_index()
+        record.process_prefix = f"[p{idx}]"
+        if self.clamp_nonzero and idx != 0 and record.levelno < logging.WARNING:
+            return False
         return True
 
 
@@ -43,12 +52,27 @@ def _process_index() -> int:
     as ``native/launcher`` does). With neither, assume rank 0 — fail-open:
     too much logging beats silently losing a host's warnings."""
     jax_mod = sys.modules.get("jax")
-    if jax_mod is not None:
+    if jax_mod is not None and _backend_initialized():
         try:
             return jax_mod.process_index()
         except Exception:
             pass
     return int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+
+
+def _backend_initialized() -> bool:
+    """True iff a JAX backend has already been created. ``jax.process_index``
+    *initialises* the backend as a side effect — logging must never do that
+    (it would lock the platform before the CLI's ``--device``/virtual-device
+    flags are applied)."""
+    try:
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return xla_bridge.backends_are_initialized()
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
 
 
 def get_logger(name: str = _ROOT_NAME) -> logging.Logger:
@@ -82,16 +106,17 @@ def setup_logging(
         root.removeHandler(h)
         h.close()
 
-    effective = level if (all_processes or _process_index() == 0) else max(
-        level, logging.WARNING
-    )
-    root.setLevel(effective)
+    root.setLevel(level)
     root.propagate = False
 
+    # The rank clamp lives in the per-record filter (not a one-shot level
+    # computation) so it holds on hosts whose rank is only known after
+    # jax.distributed initialises — setup_logging typically runs before that.
+    flt = _ProcessPrefixFilter(clamp_nonzero=not all_processes)
     fmt = logging.Formatter(_FORMAT)
     console = logging.StreamHandler(stream if stream is not None else sys.stderr)
     console.setFormatter(fmt)
-    console.addFilter(_ProcessPrefixFilter())
+    console.addFilter(flt)
     root.addHandler(console)
 
     if log_file:
@@ -99,7 +124,7 @@ def setup_logging(
             log_file, maxBytes=rotate_mb * 1024 * 1024, backupCount=3
         )
         fileh.setFormatter(fmt)
-        fileh.addFilter(_ProcessPrefixFilter())
+        fileh.addFilter(flt)
         root.addHandler(fileh)
 
     return root
