@@ -79,6 +79,42 @@ class TestCLI:
         assert len(toks) == 1 and len(toks[0]) == 16
         assert all(0 <= t < 64 for t in toks[0])
 
+    def test_train_checkpoint_and_resume(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        args = [
+            "--mode", "train", "--device", "cpu", "--seq-len", "32",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "2", "--batch", "1",
+            "--dtype", "float32", "--iters", "1", "--ckpt-dir", ckpt,
+        ]
+        run_cli(*args)
+        record, logs = run_cli(*args, "--resume")
+        assert "resumed from step 1" in logs
+        assert len(record["losses"]) == 2
+
+    def test_ckpt_every_force_saves_final_step(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        run_cli(
+            "--mode", "train", "--device", "cpu", "--seq-len", "32",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "4", "--batch", "1",
+            "--dtype", "float32", "--iters", "1",
+            "--ckpt-dir", ckpt, "--ckpt-every", "3",
+        )
+        import os
+        steps = sorted(int(d) for d in os.listdir(ckpt) if d.isdigit())
+        assert 3 in steps  # final step force-saved despite the interval
+
+    def test_resume_without_ckpt_dir_errors(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tree_attention_tpu", "--mode", "train",
+             "--resume", "--device", "cpu", "--seq-len", "32",
+             "--model-dim", "32", "--heads", "2", "--dtype", "float32"],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode != 0
+        assert "--resume requires --ckpt-dir" in proc.stderr
+
     def test_log_file_flag(self, tmp_path):
         log = tmp_path / "cli.log"
         run_cli(*TINY, "--log-file", str(log))

@@ -1,0 +1,150 @@
+"""Checkpoint / resume: sharded train-state persistence via Orbax.
+
+The reference has nothing to checkpoint — no model, no optimizer, no resume
+(SURVEY.md §5 records the absence). This framework has a real train state
+(:data:`tree_attention_tpu.models.train.TrainState` — params + optax state),
+so it gets the subsystem the reference never needed, built TPU-native:
+
+- Orbax ``CheckpointManager`` with async save and retention (``max_to_keep``);
+- **sharding-preserving restore**: each host reads exactly its own shards of
+  a ``NamedSharding``-placed state (no host ever materialises the full
+  pytree), and the restored arrays land with the same mesh placement they
+  were saved with — resume composes with ``make_train_step``'s donation;
+- a JSON sidecar for the model config, so a checkpoint directory is
+  self-describing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+import orbax.checkpoint as ocp
+
+from tree_attention_tpu.models.transformer import TransformerConfig
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("checkpoint")
+
+_CONFIG_FILE = "model_config.json"
+
+
+def _abstract_like(tree: Any) -> Any:
+    """ShapeDtypeStructs (with shardings where present) describing ``tree``.
+
+    Accepts a concrete state or one already made of ShapeDtypeStructs.
+    """
+
+    def leaf(x):
+        sharding = getattr(x, "sharding", None)
+        if isinstance(x, (int, float, np.ndarray)) or not hasattr(x, "shape"):
+            x = np.asarray(x)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree.map(leaf, tree)
+
+
+def save_model_config(directory: str, cfg: TransformerConfig) -> None:
+    """Write the architecture sidecar (dtype stored by name)."""
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = np.dtype(cfg.dtype).name
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, _CONFIG_FILE)
+    if jax.process_index() == 0:
+        with open(path, "w") as f:
+            json.dump(d, f, indent=2)
+
+
+def load_model_config(directory: str) -> TransformerConfig:
+    import jax.numpy as jnp
+
+    with open(os.path.join(directory, _CONFIG_FILE)) as f:
+        d = json.load(f)
+    d["dtype"] = jnp.dtype(d["dtype"])
+    return TransformerConfig(**d)
+
+
+class Checkpointer:
+    """Step-indexed checkpoint manager for a (params, opt_state) train state.
+
+    Usage::
+
+        ckpt = Checkpointer(dir, max_to_keep=3)
+        ckpt.save(step, state)                       # async; fenced on exit
+        state, step = ckpt.restore(state_template)   # sharded, latest step
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: Optional[int] = 3,
+        save_interval_steps: int = 1,
+    ):
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(
+        self, step: int, state: Any, *, cfg: Optional[TransformerConfig] = None,
+        force: bool = False,
+    ) -> bool:
+        """Queue an async save of ``state`` at ``step``; returns whether a
+        save was started (the manager skips off-interval steps)."""
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved and cfg is not None and not os.path.exists(
+            os.path.join(self.directory, _CONFIG_FILE)
+        ):
+            save_model_config(self.directory, cfg)
+        if saved:
+            log.info("checkpoint queued: step %d -> %s", step, self.directory)
+        return saved
+
+    def restore(
+        self, state_template: Any, step: Optional[int] = None
+    ) -> Tuple[Any, int]:
+        """Restore ``(state, step)``; ``state_template`` supplies shapes,
+        dtypes and shardings (a concrete state or ShapeDtypeStruct tree)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}"
+                )
+        abstract = _abstract_like(state_template)
+        state = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        log.info("checkpoint restored: step %d from %s", step, self.directory)
+        return state, step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list:
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

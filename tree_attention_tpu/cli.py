@@ -136,25 +136,60 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     tcfg = _transformer_config(cfg)
     opt = default_optimizer()
     state = init_train_state(jax.random.PRNGKey(cfg.seed), tcfg, opt, mesh=mesh)
-    step = make_train_step(tcfg, opt, mesh=mesh)
+    # Donation reuses the old state's buffers — unsafe while an async
+    # checkpoint save may still be reading them, so it's off when saving.
+    step = make_train_step(tcfg, opt, mesh=mesh, donate=not cfg.ckpt_dir)
     log.info(
         "transformer: %d params, %d layers, d_model %d, seq %d",
         count_params(state[0]), tcfg.n_layers, tcfg.d_model, cfg.seq_len,
     )
+    if cfg.resume and not cfg.ckpt_dir:
+        raise SystemExit("--resume requires --ckpt-dir")
+    ckpt = start_step = None
+    if cfg.ckpt_dir:
+        import contextlib
+
+        from tree_attention_tpu.checkpoint import Checkpointer, load_model_config
+
+        ckpt = Checkpointer(cfg.ckpt_dir, save_interval_steps=cfg.ckpt_every)
+        if cfg.resume and ckpt.latest_step() is not None:
+            with contextlib.suppress(FileNotFoundError):
+                saved_cfg = load_model_config(cfg.ckpt_dir)
+                if saved_cfg != tcfg:
+                    raise SystemExit(
+                        f"checkpoint config in {cfg.ckpt_dir} disagrees with "
+                        f"the CLI flags:\n  saved: {saved_cfg}\n  flags: {tcfg}"
+                    )
+            state, start_step = ckpt.restore(state)
+            log.info("resumed from step %d", start_step)
+    start = 0 if start_step is None else start_step + 1
     key = jax.random.PRNGKey(cfg.seed + 1)
     losses = []
-    for i in range(cfg.steps):
-        batch = make_lm_batch(
-            jax.random.fold_in(key, i), batch=cfg.batch, seq_len=cfg.seq_len,
-            vocab_size=tcfg.vocab_size, mesh=mesh,
-        )
-        state, loss = step(state, batch)
-        losses.append(float(loss))
-        log.info("step %d: loss %.4f", i, losses[-1])
-    # Throughput of the compiled step (last batch, post-compile). A separate
-    # non-donating step: timing re-runs with the same state, so its buffers
-    # must survive the call.
-    step_t = make_train_step(tcfg, opt, mesh=mesh, donate=False)
+    saved_last = True
+    try:
+        for i in range(start, start + cfg.steps):
+            batch = make_lm_batch(
+                jax.random.fold_in(key, i), batch=cfg.batch,
+                seq_len=cfg.seq_len, vocab_size=tcfg.vocab_size, mesh=mesh,
+            )
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+            log.info("step %d: loss %.4f", i, losses[-1])
+            if ckpt is not None:
+                saved_last = ckpt.save(i, state, cfg=tcfg)
+        if ckpt is not None and cfg.steps > 0 and not saved_last:
+            # The save interval skipped the final step; the resumable state
+            # must include all completed work.
+            ckpt.save(start + cfg.steps - 1, state, cfg=tcfg, force=True)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    # Throughput of the compiled step (last batch, post-compile). Timing
+    # re-runs with the same state, so a donating step can't be reused —
+    # with --ckpt-dir the step is already non-donating.
+    step_t = step if cfg.ckpt_dir else make_train_step(
+        tcfg, opt, mesh=mesh, donate=False
+    )
     stats = time_fn(step_t, state, batch, iters=max(cfg.iters, 1), warmup=1)
     toks = cfg.batch * cfg.seq_len
     log.info(

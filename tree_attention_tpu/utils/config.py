@@ -67,6 +67,11 @@ class RunConfig:
     n_layers: int = 2
     vocab_size: int = 4096
 
+    # Checkpointing (train mode).
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 1
+    resume: bool = False
+
     # Observability.
     log_level: str = "info"
     log_file: Optional[str] = None
@@ -119,6 +124,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-dim", type=int, default=d.model_dim)
     p.add_argument("--n-layers", type=int, default=d.n_layers)
     p.add_argument("--vocab-size", type=int, default=d.vocab_size)
+    p.add_argument("--ckpt-dir", default=d.ckpt_dir,
+                   help="train mode: checkpoint directory (enables saving)")
+    p.add_argument("--ckpt-every", type=int, default=d.ckpt_every,
+                   help="save every N steps")
+    p.add_argument("--resume", action="store_true", default=d.resume,
+                   help="resume from the latest checkpoint in --ckpt-dir")
     p.add_argument("--log-level", choices=["debug", "info", "warning", "error"],
                    default=d.log_level)
     p.add_argument("--log-file", default=d.log_file,
