@@ -1,0 +1,277 @@
+// Host-runtime native library for tree_attention_tpu.
+//
+// The reference's host-side native substrate is whatever libtorch ships:
+// ATen's Philox RNG behind torch.manual_seed (/root/reference/model.py:50)
+// and torch.multiprocessing's fork/exec layer behind mp.spawn
+// (/root/reference/model.py:165). This library is the TPU framework's own
+// equivalent, with no torch in sight:
+//
+//  - a Philox4x32-10 counter-based RNG (deterministic in (seed, counter),
+//    embarrassingly parallel — the same construction ATen uses);
+//  - a multi-threaded prefetching batch pipeline: worker threads generate
+//    token batches ahead of the consumer into a bounded, strictly-ordered
+//    ring (batch i is always delivered i-th, regardless of worker timing),
+//    so host data generation overlaps device compute;
+//  - a local process launcher: fork/exec N ranks with JAX_PROCESS_INDEX /
+//    TA_NUM_PROCESSES exported, wait for all (the mp.spawn shape).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <csignal>
+#include <cstdio>
+
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+// ----------------------------------------------------------------------------
+// Philox4x32-10 (Salmon et al., "Parallel random numbers: as easy as 1, 2, 3")
+// ----------------------------------------------------------------------------
+
+struct Philox {
+  uint32_t key[2];
+  uint32_t ctr[4];
+
+  static void round_(uint32_t ctr[4], const uint32_t key[2]) {
+    constexpr uint64_t M0 = 0xD2511F53ull, M1 = 0xCD9E8D57ull;
+    const uint64_t p0 = M0 * static_cast<uint64_t>(ctr[0]);
+    const uint64_t p1 = M1 * static_cast<uint64_t>(ctr[2]);
+    const uint32_t c0 = static_cast<uint32_t>(p1 >> 32) ^ ctr[1] ^ key[0];
+    const uint32_t c1 = static_cast<uint32_t>(p1);
+    const uint32_t c2 = static_cast<uint32_t>(p0 >> 32) ^ ctr[3] ^ key[1];
+    const uint32_t c3 = static_cast<uint32_t>(p0);
+    ctr[0] = c0; ctr[1] = c1; ctr[2] = c2; ctr[3] = c3;
+  }
+
+  // One 10-round block for (seed, counter128): fills out[4].
+  static void block(uint64_t seed, uint64_t ctr_hi, uint64_t ctr_lo,
+                    uint32_t out[4]) {
+    uint32_t key[2] = {static_cast<uint32_t>(seed),
+                       static_cast<uint32_t>(seed >> 32)};
+    uint32_t ctr[4] = {static_cast<uint32_t>(ctr_lo),
+                       static_cast<uint32_t>(ctr_lo >> 32),
+                       static_cast<uint32_t>(ctr_hi),
+                       static_cast<uint32_t>(ctr_hi >> 32)};
+    for (int i = 0; i < 10; ++i) {
+      round_(ctr, key);
+      key[0] += 0x9E3779B9u;  // golden-ratio Weyl bumps
+      key[1] += 0xBB67AE85u;
+    }
+    std::memcpy(out, ctr, sizeof(ctr));
+  }
+};
+
+inline float u32_to_unit_float(uint32_t x) {
+  // (0, 1]: never 0, safe for log().
+  return (static_cast<float>(x >> 8) + 1.0f) * (1.0f / 16777216.0f);
+}
+
+void fill_u32(uint32_t* out, size_t n, uint64_t seed, uint64_t stream) {
+  uint32_t blk[4];
+  size_t i = 0;
+  for (uint64_t c = 0; i < n; ++c) {
+    Philox::block(seed, stream, c, blk);
+    for (int j = 0; j < 4 && i < n; ++j) out[i++] = blk[j];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fill `out[n]` with uint32s from the (seed, stream) Philox stream.
+void ta_fill_u32(uint32_t* out, size_t n, uint64_t seed, uint64_t stream) {
+  fill_u32(out, n, seed, stream);
+}
+
+// Fill `out[n]` with standard normals (Box-Muller over Philox uniforms).
+void ta_fill_normal_f32(float* out, size_t n, uint64_t seed, uint64_t stream) {
+  uint32_t blk[4];
+  size_t i = 0;
+  for (uint64_t c = 0; i < n; ++c) {
+    Philox::block(seed, stream, c, blk);
+    for (int j = 0; j < 4 && i < n; j += 2) {
+      const float u1 = u32_to_unit_float(blk[j]);
+      const float u2 = u32_to_unit_float(blk[j + 1]);
+      const float r = std::sqrt(-2.0f * std::log(u1));
+      const float t = 6.28318530717958647692f * u2;
+      out[i++] = r * std::cos(t);
+      if (i < n && j + 1 < 4) out[i++] = r * std::sin(t);
+    }
+  }
+}
+
+// Fill `out[n]` with token ids in [0, vocab) (rejection-free modulo; bias is
+// negligible for vocab << 2^32 and irrelevant for synthetic LM data).
+void ta_fill_tokens_i32(int32_t* out, size_t n, uint32_t vocab, uint64_t seed,
+                        uint64_t stream) {
+  std::vector<uint32_t> buf(n);
+  fill_u32(buf.data(), n, seed, stream);
+  for (size_t i = 0; i < n; ++i)
+    out[i] = static_cast<int32_t>(buf[i] % vocab);
+}
+
+// ----------------------------------------------------------------------------
+// Prefetching batch pipeline
+// ----------------------------------------------------------------------------
+
+struct TaPipeline {
+  size_t batch_elems;
+  uint32_t vocab;
+  uint64_t seed;
+  size_t depth;
+  std::vector<std::thread> workers;
+
+  std::mutex mu;
+  std::condition_variable cv_ready;   // consumer waits for ready[head]
+  std::condition_variable cv_space;   // workers wait for room in the window
+  std::map<uint64_t, std::vector<int32_t>> ready;
+  std::atomic<uint64_t> next_claim{0};
+  uint64_t head = 0;
+  bool stop = false;
+
+  void worker() {
+    for (;;) {
+      uint64_t idx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] {
+          return stop || next_claim.load() < head + depth;
+        });
+        if (stop) return;
+        idx = next_claim.fetch_add(1);
+      }
+      std::vector<int32_t> batch(batch_elems);
+      // Content depends only on (seed, idx): worker count/timing never
+      // changes what batch `idx` contains — reproducibility is structural.
+      ta_fill_tokens_i32(batch.data(), batch_elems, vocab, seed, idx);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (stop) return;
+        ready.emplace(idx, std::move(batch));
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+// `start` = index of the first batch delivered (resume support: batch
+// content is a pure function of (seed, index), so resuming at step k just
+// starts the window there).
+TaPipeline* ta_pipeline_create(size_t batch_elems, uint32_t vocab,
+                               uint64_t seed, int depth, int n_workers,
+                               uint64_t start) {
+  if (batch_elems == 0 || vocab == 0 || depth < 1 || n_workers < 1)
+    return nullptr;
+  auto* p = new TaPipeline;
+  p->batch_elems = batch_elems;
+  p->vocab = vocab;
+  p->seed = seed;
+  p->depth = static_cast<size_t>(depth);
+  p->next_claim.store(start);
+  p->head = start;
+  for (int i = 0; i < n_workers; ++i)
+    p->workers.emplace_back([p] { p->worker(); });
+  return p;
+}
+
+// Copy the next in-order batch into out[batch_elems]; returns its index.
+int64_t ta_pipeline_next(TaPipeline* p, int32_t* out) {
+  std::vector<int32_t> batch;
+  uint64_t idx;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    idx = p->head;
+    p->cv_ready.wait(lk, [&] { return p->stop || p->ready.count(idx); });
+    if (p->stop) return -1;
+    batch = std::move(p->ready[idx]);
+    p->ready.erase(idx);
+    p->head = idx + 1;
+  }
+  p->cv_space.notify_all();
+  std::memcpy(out, batch.data(), p->batch_elems * sizeof(int32_t));
+  return static_cast<int64_t>(idx);
+}
+
+void ta_pipeline_destroy(TaPipeline* p) {
+  if (!p) return;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv_space.notify_all();
+  p->cv_ready.notify_all();
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+// ----------------------------------------------------------------------------
+// Local process launcher (the mp.spawn shape)
+// ----------------------------------------------------------------------------
+
+extern char** environ;
+
+// Fork/exec `nprocs` copies of argv (NULL-terminated), each with
+// JAX_PROCESS_INDEX=<rank> and TA_NUM_PROCESSES=<nprocs> exported. Blocks
+// until all exit; writes each child's exit status into statuses[nprocs].
+// Returns the number of children with nonzero status (or -1 on fork failure).
+//
+// The caller is typically multithreaded (JAX runtime / pipeline workers), so
+// the child between fork() and exec must only make async-signal-safe calls:
+// each rank's environment array is fully built in the parent; the child does
+// nothing but execvpe + _exit.
+int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
+  std::vector<pid_t> pids(nprocs);
+
+  // Parent-side env construction (one array per rank).
+  std::vector<std::vector<std::string>> env_strs(nprocs);
+  std::vector<std::vector<char*>> envps(nprocs);
+  for (int r = 0; r < nprocs; ++r) {
+    for (char** e = environ; *e; ++e) {
+      if (std::strncmp(*e, "JAX_PROCESS_INDEX=", 18) == 0) continue;
+      if (std::strncmp(*e, "TA_NUM_PROCESSES=", 17) == 0) continue;
+      env_strs[r].emplace_back(*e);
+    }
+    env_strs[r].emplace_back("JAX_PROCESS_INDEX=" + std::to_string(r));
+    env_strs[r].emplace_back("TA_NUM_PROCESSES=" + std::to_string(nprocs));
+    for (auto& s : env_strs[r]) envps[r].push_back(const_cast<char*>(s.c_str()));
+    envps[r].push_back(nullptr);
+  }
+
+  for (int r = 0; r < nprocs; ++r) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      for (int k = 0; k < r; ++k) kill(pids[k], SIGTERM);
+      return -1;
+    }
+    if (pid == 0) {
+      execvpe(argv[0], const_cast<char* const*>(argv), envps[r].data());
+      _exit(127);  // exec failed
+    }
+    pids[r] = pid;
+  }
+  int failures = 0;
+  for (int r = 0; r < nprocs; ++r) {
+    int st = 0;
+    waitpid(pids[r], &st, 0);
+    const int code = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
+    if (statuses) statuses[r] = code;
+    if (code != 0) ++failures;
+  }
+  return failures;
+}
+
+}  // extern "C"

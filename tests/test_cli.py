@@ -115,6 +115,17 @@ class TestCLI:
         assert proc.returncode != 0
         assert "--resume requires --ckpt-dir" in proc.stderr
 
+    def test_train_host_data_pipeline(self):
+        record, logs = run_cli(
+            "--mode", "train", "--device", "cpu", "--seq-len", "32",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "2", "--batch", "1",
+            "--dtype", "float32", "--iters", "1", "--host-data",
+            "--n-virtual-cpu", "2", "--mesh", "seq=2",
+        )
+        assert "host data pipeline" in logs
+        assert len(record["losses"]) == 2 and all(l > 0 for l in record["losses"])
+
     def test_log_file_flag(self, tmp_path):
         log = tmp_path / "cli.log"
         run_cli(*TINY, "--log-file", str(log))

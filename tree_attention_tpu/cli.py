@@ -127,9 +127,12 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     lacks entirely — no loss, no backward, no optimizer)."""
     import jax
 
+    import jax.numpy as jnp
+
     from tree_attention_tpu.data import make_lm_batch
     from tree_attention_tpu.models import (
         count_params, default_optimizer, init_train_state, make_train_step,
+        shard_batch,
     )
     from tree_attention_tpu.utils.profiling import time_fn
 
@@ -164,14 +167,35 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             log.info("resumed from step %d", start_step)
     start = 0 if start_step is None else start_step + 1
     key = jax.random.PRNGKey(cfg.seed + 1)
+    pipe = None
+    if cfg.host_data:
+        from tree_attention_tpu.host_runtime import HostDataPipeline, native_available
+
+        # Batch content is a pure function of (seed, step index), so resume
+        # starts the pipeline at `start` — no replayed training data.
+        pipe = HostDataPipeline(
+            (cfg.batch, cfg.seq_len + 1), tcfg.vocab_size, cfg.seed + 1,
+            start=start,
+        )
+        log.info("host data pipeline (native=%s)", native_available())
+
+    def next_batch(i):
+        if pipe is None:
+            return make_lm_batch(
+                jax.random.fold_in(key, i), batch=cfg.batch,
+                seq_len=cfg.seq_len, vocab_size=tcfg.vocab_size, mesh=mesh,
+            )
+        toks = pipe.next()  # numpy; slice as host views, one transfer each
+        b = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+        if mesh is not None:
+            return shard_batch(mesh, b)
+        return {k: jnp.asarray(v) for k, v in b.items()}
+
     losses = []
     saved_last = True
     try:
         for i in range(start, start + cfg.steps):
-            batch = make_lm_batch(
-                jax.random.fold_in(key, i), batch=cfg.batch,
-                seq_len=cfg.seq_len, vocab_size=tcfg.vocab_size, mesh=mesh,
-            )
+            batch = next_batch(i)
             state, loss = step(state, batch)
             losses.append(float(loss))
             log.info("step %d: loss %.4f", i, losses[-1])
@@ -182,6 +206,8 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             # must include all completed work.
             ckpt.save(start + cfg.steps - 1, state, cfg=tcfg, force=True)
     finally:
+        if pipe is not None:
+            pipe.close()
         if ckpt is not None:
             ckpt.close()
     # Throughput of the compiled step (last batch, post-compile). Timing

@@ -1,0 +1,257 @@
+"""ctypes bindings for the native host runtime (``native/treeattn_host.cc``).
+
+The reference gets its host-side native capability for free from libtorch:
+ATen's Philox RNG (``/root/reference/model.py:50``) and multiprocessing's
+fork/exec layer (``model.py:165``). This module binds the framework's own C++
+equivalents — counter-based RNG fills, a prefetching batch pipeline, and a
+local process launcher — compiling the shared library on first use (g++ is
+part of the toolchain; there is no pybind11 in this image, hence ctypes).
+
+Everything degrades gracefully: if the compiler or library is unavailable,
+:func:`philox_tokens` / :class:`HostDataPipeline` fall back to NumPy's own
+Philox implementation (same counter-based construction, different stream),
+and :func:`launch_local` falls back to ``subprocess``. The contract is
+"deterministic in (seed, index) within a backend", not cross-backend
+bit-equality — synthetic data needs no more.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("host_runtime")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libtreeattn_host.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "treeattn_host.cc")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _compile() -> bool:
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            log.warning("native build failed:\n%s", proc.stderr[-2000:])
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %s", e)
+        return False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_tried
+    with _lib_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        stale = not os.path.exists(_SO_PATH) or (
+            os.path.exists(_SRC_PATH)
+            and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
+        )
+        if stale and not _compile():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("native library load failed: %s", e)
+            return None
+        lib.ta_fill_u32.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.ta_fill_normal_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.ta_fill_tokens_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.ta_pipeline_create.restype = ctypes.c_void_p
+        lib.ta_pipeline_create.argtypes = [
+            ctypes.c_size_t, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ]
+        lib.ta_pipeline_next.restype = ctypes.c_int64
+        lib.ta_pipeline_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ta_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        lib.ta_launch_processes.restype = ctypes.c_int
+        lib.ta_launch_processes.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        _lib = lib
+        log.info("native host runtime loaded: %s", _SO_PATH)
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+# ---------------------------------------------------------------------------
+# RNG fills
+# ---------------------------------------------------------------------------
+
+
+def philox_normal(shape: Sequence[int], seed: int, stream: int = 0) -> np.ndarray:
+    """Standard normals, deterministic in (seed, stream)."""
+    n = int(np.prod(shape))
+    lib = load_native()
+    if lib is not None:
+        out = np.empty(n, np.float32)
+        lib.ta_fill_normal_f32(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, seed & (2**64 - 1), stream & (2**64 - 1),
+        )
+        return out.reshape(shape)
+    gen = np.random.Generator(np.random.Philox(key=seed, counter=[0, 0, 0, stream]))
+    return gen.standard_normal(n, dtype=np.float32).reshape(shape)
+
+
+def philox_tokens(
+    shape: Sequence[int], vocab: int, seed: int, stream: int = 0
+) -> np.ndarray:
+    """Token ids in [0, vocab), deterministic in (seed, stream)."""
+    n = int(np.prod(shape))
+    lib = load_native()
+    if lib is not None:
+        out = np.empty(n, np.int32)
+        lib.ta_fill_tokens_i32(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, vocab, seed & (2**64 - 1), stream & (2**64 - 1),
+        )
+        return out.reshape(shape)
+    gen = np.random.Generator(np.random.Philox(key=seed, counter=[0, 0, 0, stream]))
+    return gen.integers(0, vocab, size=n, dtype=np.int32).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Prefetching batch pipeline
+# ---------------------------------------------------------------------------
+
+
+class HostDataPipeline:
+    """Prefetching token-batch source: C++ worker threads fill ahead.
+
+    Batch ``i`` always has the content of ``philox_tokens(shape, vocab,
+    seed, stream=i)`` (native stream) regardless of worker count or timing;
+    only the prefetch overlap is concurrent, never the content.
+
+    Use as a context manager::
+
+        with HostDataPipeline((B, T), vocab, seed) as pipe:
+            for _ in range(steps):
+                batch = pipe.next()          # np.int32 (B, T)
+    """
+
+    def __init__(
+        self,
+        batch_shape: Sequence[int],
+        vocab: int,
+        seed: int,
+        *,
+        depth: int = 4,
+        workers: int = 2,
+        start: int = 0,
+    ):
+        self._handle = None  # before any validation: __del__ must be safe
+        self.batch_shape = tuple(int(s) for s in batch_shape)
+        self.vocab = int(vocab)
+        self.seed = int(seed)
+        self._elems = int(np.prod(self.batch_shape))
+        if self._elems <= 0 or self.vocab <= 0:
+            raise ValueError(
+                f"bad pipeline config: shape={batch_shape} vocab={vocab}"
+            )
+        if start < 0:
+            raise ValueError(f"start must be >= 0, got {start}")
+        self._lib = load_native()
+        self._fallback_idx = start
+        if self._lib is not None:
+            self._handle = self._lib.ta_pipeline_create(
+                self._elems, self.vocab, self.seed & (2**64 - 1),
+                int(depth), int(workers), int(start),
+            )
+            if not self._handle:
+                raise RuntimeError("ta_pipeline_create failed")
+
+    def next(self) -> np.ndarray:
+        if self._handle:
+            out = np.empty(self._elems, np.int32)
+            idx = self._lib.ta_pipeline_next(
+                self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            )
+            if idx < 0:
+                raise RuntimeError("pipeline stopped")
+            return out.reshape(self.batch_shape)
+        idx = self._fallback_idx
+        self._fallback_idx += 1
+        return philox_tokens(self.batch_shape, self.vocab, self.seed, idx)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ta_pipeline_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "HostDataPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Local process launcher
+# ---------------------------------------------------------------------------
+
+
+def launch_local(argv: Sequence[str], nprocs: int) -> Tuple[int, List[int]]:
+    """Run ``nprocs`` copies of ``argv``, each with ``JAX_PROCESS_INDEX`` /
+    ``TA_NUM_PROCESSES`` exported; returns (failure_count, per-rank statuses).
+
+    The reference's ``mp.spawn(main, nprocs=N)`` (``model.py:165``), as an
+    exec-based launcher (no fork-inheriting a possibly-initialised JAX).
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    lib = load_native()
+    if lib is not None:
+        c_argv = (ctypes.c_char_p * (len(argv) + 1))(
+            *[a.encode() for a in argv], None
+        )
+        statuses = (ctypes.c_int * nprocs)()
+        failures = lib.ta_launch_processes(c_argv, nprocs, statuses)
+        if failures < 0:
+            raise OSError("fork failed in ta_launch_processes")
+        return failures, list(statuses)
+    procs = []
+    for r in range(nprocs):
+        env = dict(os.environ)
+        env["JAX_PROCESS_INDEX"] = str(r)
+        env["TA_NUM_PROCESSES"] = str(nprocs)
+        procs.append(subprocess.Popen(list(argv), env=env))
+    statuses = [p.wait() for p in procs]
+    return sum(1 for s in statuses if s != 0), statuses

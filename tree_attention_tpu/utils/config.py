@@ -67,6 +67,9 @@ class RunConfig:
     n_layers: int = 2
     vocab_size: int = 4096
 
+    # Host data pipeline (train mode).
+    host_data: bool = False
+
     # Checkpointing (train mode).
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 1
@@ -124,6 +127,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-dim", type=int, default=d.model_dim)
     p.add_argument("--n-layers", type=int, default=d.n_layers)
     p.add_argument("--vocab-size", type=int, default=d.vocab_size)
+    p.add_argument("--host-data", action="store_true", default=d.host_data,
+                   help="train mode: feed batches from the native prefetching "
+                        "host pipeline instead of on-device RNG")
     p.add_argument("--ckpt-dir", default=d.ckpt_dir,
                    help="train mode: checkpoint directory (enables saving)")
     p.add_argument("--ckpt-every", type=int, default=d.ckpt_every,

@@ -45,19 +45,35 @@ class _ProcessPrefixFilter(logging.Filter):
 
 
 def _process_index() -> int:
-    """Best-effort host rank. JAX (if imported) is authoritative; the
-    ``JAX_PROCESS_INDEX`` env var is an *explicit launcher-set override* for
-    logging before the backend initialises (JAX itself never sets it — a
-    multi-host launcher that wants pre-init rank-aware logging exports it,
-    as ``native/launcher`` does). With neither, assume rank 0 — fail-open:
-    too much logging beats silently losing a host's warnings."""
+    """Best-effort host rank. A JAX *distributed* runtime is authoritative;
+    otherwise an explicitly exported ``JAX_PROCESS_INDEX`` wins (JAX never
+    sets it — a launcher that wants rank-aware logging exports it, as
+    :func:`tree_attention_tpu.host_runtime.launch_local` does; without it a
+    launcher-spawned child would see itself as an independent rank-0 world).
+    With neither, assume rank 0 — fail-open: too much logging beats silently
+    losing a host's warnings."""
     jax_mod = sys.modules.get("jax")
+    if jax_mod is not None and _distributed_initialized(jax_mod):
+        try:
+            return jax_mod.process_index()
+        except Exception:
+            pass
+    env = os.environ.get("JAX_PROCESS_INDEX")
+    if env is not None:
+        return int(env)
     if jax_mod is not None and _backend_initialized():
         try:
             return jax_mod.process_index()
         except Exception:
             pass
-    return int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+    return 0
+
+
+def _distributed_initialized(jax_mod) -> bool:
+    try:
+        return jax_mod.distributed.is_initialized()
+    except Exception:
+        return False
 
 
 def _backend_initialized() -> bool:
