@@ -1,0 +1,106 @@
+"""Debug utilities: checkify wrapping, divergence and determinism checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.parallel.mesh import AXIS_SEQ, cpu_mesh
+from tree_attention_tpu.parallel.tree import tree_decode
+from tree_attention_tpu.utils.debug import (
+    assert_deterministic,
+    assert_finite,
+    assert_replicated_identical,
+    checked,
+)
+
+
+class TestChecked:
+    def test_passes_clean_attention(self):
+        k = jax.random.PRNGKey(0)
+        q = jax.random.normal(k, (1, 2, 4, 8))
+        fn = checked(lambda q: flash_attention(q, q, q, impl="blockwise",
+                                               block_size=4)[0])
+        out = fn(q)
+        assert out.shape == q.shape
+
+    def test_catches_nan(self):
+        fn = checked(lambda x: jnp.log(x) / jnp.sum(x))
+        with pytest.raises(Exception, match="nan|division"):
+            fn(jnp.array([-1.0, 1.0]))
+
+    def test_internal_jit(self):
+        calls = []
+
+        def f(x):
+            calls.append(0)
+            return x * 2
+
+        fn = checked(f)
+        np.testing.assert_array_equal(np.asarray(fn(jnp.ones(3))), 2.0)
+        fn(jnp.ones(3))
+        assert len(calls) == 1  # traced once: the body really is jitted
+
+
+class TestAssertFinite:
+    def test_clean(self):
+        assert_finite({"a": jnp.ones(3), "b": jnp.zeros(2)})
+
+    def test_nan_reported_with_path(self):
+        with pytest.raises(FloatingPointError, match=r"\['b'\].*1 NaN"):
+            assert_finite({"a": jnp.ones(3), "b": jnp.array([1.0, jnp.nan])},
+                          name="params")
+
+
+class TestReplicatedIdentical:
+    def test_replicated_ok(self):
+        mesh = cpu_mesh(4)
+        x = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P()))
+        assert_replicated_identical(x)
+
+    def test_tree_decode_output_consistent(self):
+        mesh = cpu_mesh(4)
+        k = jax.random.PRNGKey(1)
+        q = jax.random.normal(k, (1, 2, 1, 8), jnp.float32)
+        kv = jax.random.normal(jax.random.fold_in(k, 1), (1, 2, 64, 8),
+                               jnp.float32)
+        q = jax.device_put(q, NamedSharding(mesh, P()))
+        kv = jax.device_put(kv, NamedSharding(mesh, P(None, None, AXIS_SEQ)))
+        out, _ = tree_decode(q, kv, kv, mesh=mesh)
+        assert_replicated_identical(out, name="tree_decode.out")
+
+    def test_divergence_detected(self):
+        mesh = cpu_mesh(4)
+        # Build a "replicated" array whose shards actually differ, via
+        # shard_map with an (incorrect) unchecked replicated out_spec.
+        import functools
+
+        f = functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P(AXIS_SEQ),
+            out_specs=P(), check_vma=False,
+        )(lambda x: x + jax.lax.axis_index(AXIS_SEQ).astype(x.dtype))
+        y = f(jnp.zeros(8, jnp.float32))
+        with pytest.raises(AssertionError, match="diverge"):
+            assert_replicated_identical(y, name="bad")
+
+
+class TestDeterministic:
+    def test_deterministic_op(self):
+        k = jax.random.PRNGKey(0)
+        q = jax.random.normal(k, (1, 2, 16, 8))
+        fn = jax.jit(lambda q: flash_attention(q, q, q, impl="blockwise",
+                                               block_size=8)[0])
+        out = assert_deterministic(fn, q, runs=3)
+        assert out.shape == q.shape
+
+    def test_nondeterminism_detected(self):
+        calls = []
+
+        def flaky(x):
+            calls.append(0)
+            return x + len(calls)
+
+        with pytest.raises(AssertionError, match="differs"):
+            assert_deterministic(flaky, jnp.zeros(2))

@@ -3,7 +3,7 @@
 The reference's cross-cutting layer is loguru sprinkled through every function
 plus a rotating file sink (``/root/reference/model.py:160``) and a hardcoded
 problem size (``model.py:140-145``) with no flag system at all (SURVEY.md §5).
-Here those become three real modules:
+Here those become four real modules:
 
 - :mod:`.logging`   — structured stdlib logging, per-process prefixes,
   process-0-only default, optional rotating file sink.
@@ -11,6 +11,8 @@ Here those become three real modules:
   reproduce the reference's hardcoded run.
 - :mod:`.profiling` — fenced timing (``block_until_ready``), device memory
   stats (peak HBM), and ``jax.profiler`` trace capture.
+- :mod:`.debug`     — checkify/NaN checks, SPMD shard-divergence and
+  determinism assertions (the sanitizer story the reference lacks).
 """
 
 from tree_attention_tpu.utils.config import (  # noqa: F401
@@ -18,6 +20,12 @@ from tree_attention_tpu.utils.config import (  # noqa: F401
     build_arg_parser,
     parse_args,
     parse_mesh_spec,
+)
+from tree_attention_tpu.utils.debug import (  # noqa: F401
+    assert_deterministic,
+    assert_finite,
+    assert_replicated_identical,
+    checked,
 )
 from tree_attention_tpu.utils.logging import (  # noqa: F401
     get_logger,

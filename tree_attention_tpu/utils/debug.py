@@ -1,0 +1,121 @@
+"""Numerics & determinism debugging: the sanitizer story, JAX-style.
+
+The reference has no sanitizers, race detection, or numeric checks of any
+kind (SURVEY.md §5) — three bugs shipped in 169 lines partly because nothing
+ever checked an output. SPMD-by-construction designs away classic data races,
+so what remains worth checking on TPU is:
+
+- **NaN/Inf escape** from kernels (``checkify`` functional error checks that
+  survive ``jit``; :func:`checked` / :func:`assert_finite`);
+- **cross-shard divergence**: an array that should be replicated across a
+  mesh axis silently differing per shard — the SPMD analogue of a data race,
+  typically caused by nondeterministic collectives or shard-dependent control
+  flow (:func:`assert_replicated_identical`);
+- **cross-run nondeterminism** for an op that should be bitwise reproducible
+  (:func:`assert_deterministic`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import checkify
+
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("debug")
+
+
+def checked(
+    fn: Callable[..., Any], *, errors=checkify.float_checks, jit: bool = True
+) -> Callable[..., Any]:
+    """Wrap ``fn`` with ``checkify`` float checks; raises on NaN/Inf/div0.
+
+    The checkified body is jitted *inside* the wrapper and the error is
+    raised outside the jit boundary (``check_error`` cannot run under a
+    trace — do not wrap the result in another ``jax.jit``). Use in tests
+    and debug runs; the unchecked path has zero overhead because nothing
+    is wrapped there.
+    """
+    cfn = checkify.checkify(fn, errors=errors)
+    if jit:
+        cfn = jax.jit(cfn)
+
+    def wrapper(*args, **kwargs):
+        err, out = cfn(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper
+
+
+def assert_finite(tree: Any, name: str = "value") -> None:
+    """Eager NaN/Inf check over a pytree (host-side; fetches values)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if not np.isfinite(arr).all():
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            raise FloatingPointError(
+                f"{name}{jax.tree_util.keystr(path)}: {n_nan} NaN, "
+                f"{n_inf} Inf of {arr.size} elements"
+            )
+
+
+def assert_replicated_identical(
+    x: jax.Array, *, name: str = "array", atol: float = 0.0
+) -> None:
+    """Check a nominally-replicated array is identical on every device shard.
+
+    The SPMD divergence detector: after a ``shard_map`` whose out_spec says
+    "replicated", every addressable shard must hold the same bytes. A
+    mismatch means shard-dependent computation leaked into a replicated
+    output (the moral equivalent of a data race in the reference's NCCL
+    world). ``atol=0`` demands bitwise equality — TPU collectives are
+    deterministic, so that's the honest default.
+    """
+    shards = x.addressable_shards
+    if len(shards) < 2:
+        return
+    ref = np.asarray(shards[0].data)
+    for s in shards[1:]:
+        got = np.asarray(s.data)
+        if atol == 0.0:
+            ok = np.array_equal(ref, got, equal_nan=True)
+        else:
+            ok = np.allclose(ref, got, atol=atol, equal_nan=True)
+        if not ok:
+            diff = np.abs(ref.astype(np.float64) - got.astype(np.float64))
+            raise AssertionError(
+                f"{name}: replicated shards diverge — device "
+                f"{s.device} differs from {shards[0].device} "
+                f"(max abs diff {diff.max():.3e})"
+            )
+
+
+def assert_deterministic(
+    fn: Callable[..., Any], *args: Any, runs: int = 2, name: Optional[str] = None,
+    **kwargs: Any,
+) -> Any:
+    """Run ``fn`` ``runs`` times; raise if any output bit differs.
+
+    Catches nondeterministic reductions/scatter orders in a kernel under
+    test. Returns the (verified) first output.
+    """
+    first = jax.block_until_ready(fn(*args, **kwargs))
+    label = name or getattr(fn, "__name__", "fn")
+    for r in range(1, runs):
+        again = jax.block_until_ready(fn(*args, **kwargs))
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(first)[0],
+            jax.tree_util.tree_flatten_with_path(again)[0],
+        ):
+            if not np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True):
+                raise AssertionError(
+                    f"{label}{jax.tree_util.keystr(pa)}: run {r} differs "
+                    f"from run 0 — nondeterministic computation"
+                )
+    return first
