@@ -101,6 +101,34 @@ class TestProfiling:
         }
         json.dumps(stats.as_dict())  # JSON-serialisable for bench records
 
+    def test_time_per_step_slope(self):
+        import time as _time
+
+        from tree_attention_tpu.utils.profiling import time_per_step
+
+        def make(n):
+            def run():
+                _time.sleep(0.004 + 0.001 * n)  # fixed 4ms + 1ms/step
+
+            return run
+
+        per, s_small, s_large = time_per_step(
+            make, n_small=2, n_large=10, iters=3, warmup=0, fetch=False
+        )
+        assert 0.0005 < per < 0.002  # slope recovers ~1ms/step, not the 4ms
+        assert s_small.iters == 3 and s_large.median > s_small.median
+
+    def test_time_per_step_validates_range(self):
+        from tree_attention_tpu.utils.profiling import time_per_step
+
+        with pytest.raises(ValueError):
+            time_per_step(lambda n: (lambda: None), n_small=8, n_large=8)
+
+    def test_time_fn_fetch_fence(self):
+        stats = time_fn(lambda: jnp.arange(8.0) * 2, iters=2, warmup=1,
+                        fetch=True)
+        assert stats.iters == 2
+
     def test_time_fn_rejects_zero_iters(self):
         with pytest.raises(ValueError):
             time_fn(lambda: None, iters=0)

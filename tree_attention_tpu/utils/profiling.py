@@ -14,9 +14,10 @@ import contextlib
 import dataclasses
 import statistics
 import time
-from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 
 
 @dataclasses.dataclass
@@ -48,17 +49,33 @@ def time_fn(
     *args: Any,
     iters: int = 10,
     warmup: int = 2,
+    fetch: bool = False,
     **kwargs: Any,
 ) -> TimingStats:
-    """Time ``fn(*args, **kwargs)`` with compile warmup and result fencing."""
+    """Time ``fn(*args, **kwargs)`` with compile warmup and result fencing.
+
+    ``fetch=True`` fences by copying every output to host instead of
+    ``block_until_ready`` — required on transports where readiness
+    notifications resolve before execution finishes (observed on tunneled
+    TPU backends); it adds the device→host transfer to the measured time,
+    so pair it with :func:`time_per_step` slope timing to cancel fixed
+    overhead.
+    """
     if iters < 1:
         raise ValueError(f"iters must be >= 1, got {iters}")
+
+    def fence(res):
+        if fetch:
+            jax.tree.map(np.asarray, res)
+        else:
+            jax.block_until_ready(res)
+
     for _ in range(max(warmup, 0)):
-        jax.block_until_ready(fn(*args, **kwargs))
+        fence(fn(*args, **kwargs))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args, **kwargs))
+        fence(fn(*args, **kwargs))
         times.append(time.perf_counter() - t0)
     return TimingStats(
         median=statistics.median(times),
@@ -68,6 +85,45 @@ def time_fn(
         iters=iters,
         times=tuple(times),
     )
+
+
+def time_per_step(
+    make_fn: Callable[[int], Callable[..., Any]],
+    *args: Any,
+    n_small: int = 64,
+    n_large: int = 256,
+    iters: int = 5,
+    warmup: int = 1,
+    fetch: bool = True,
+    **kwargs: Any,
+) -> Tuple[float, TimingStats, TimingStats]:
+    """Amortised per-step cost by slope: time an ``n_small``-step and an
+    ``n_large``-step chained program and divide the difference.
+
+    Cancels every fixed cost — dispatch, RPC latency, the host fetch used as
+    the completion fence — leaving only the marginal cost of one step.
+    ``make_fn(n)`` must return a callable running ``n`` dependent steps.
+    Returns ``(seconds_per_step, stats_small, stats_large)``.
+    """
+    if not 0 < n_small < n_large:
+        raise ValueError(f"need 0 < n_small < n_large, got {n_small}, {n_large}")
+    s_small = time_fn(
+        make_fn(n_small), *args, iters=iters, warmup=warmup, fetch=fetch,
+        **kwargs,
+    )
+    s_large = time_fn(
+        make_fn(n_large), *args, iters=iters, warmup=warmup, fetch=fetch,
+        **kwargs,
+    )
+    per_step = (s_large.median - s_small.median) / (n_large - n_small)
+    if per_step <= 0:
+        raise RuntimeError(
+            f"non-positive per-step slope ({per_step:.3e}s): medians "
+            f"n={n_small}: {s_small.median:.6f}s, n={n_large}: "
+            f"{s_large.median:.6f}s — measurement noise exceeds the "
+            f"workload; raise n_large or iters"
+        )
+    return per_step, s_small, s_large
 
 
 def device_memory_stats(device: Optional[jax.Device] = None) -> Optional[Dict[str, int]]:
