@@ -59,7 +59,7 @@ def main() -> None:
     k = jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, H, T, D), jnp.bfloat16)
 
-    out = make_chain(1)(q, k, v)
+    out = jax.eval_shape(make_chain(1), q, k, v)  # shape check, no compile
     assert out.shape == (B, H, 1, D)
 
     per_step, _, _ = time_per_step(

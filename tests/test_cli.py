@@ -29,8 +29,21 @@ def run_cli(*args, timeout=180):
         capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    # stdout carries exactly one JSON record (logs go to stderr).
-    return json.loads(proc.stdout), proc.stderr
+    # stdout carries exactly one JSON object from rank 0 (logs go to stderr;
+    # native layers like Gloo may write banners to stdout around it).
+    records = []
+    for line in proc.stdout.strip().splitlines():
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            records.append(obj)
+    assert len(records) == 1, (
+        f"expected exactly one JSON record on stdout, got {len(records)}:\n"
+        f"{proc.stdout[-2000:]}"
+    )
+    return records[0], proc.stderr
 
 
 class TestCLI:
@@ -114,6 +127,31 @@ class TestCLI:
         )
         assert proc.returncode != 0
         assert "--resume requires --ckpt-dir" in proc.stderr
+
+    def test_launch_multiprocess_decode(self):
+        # The multi-host shape on one machine: 2 coordinated processes, one
+        # jax.distributed cluster, mesh spanning the process boundary.
+        record, logs = run_cli(*TINY, "--launch", "2", "--mesh", "seq=2",
+                               timeout=300)
+        assert record["name"] == "tree_decode"
+        assert record["n_devices"] == 2
+        assert "launching 2 coordinated processes" in logs
+
+    def test_launch_multiprocess_devices_pooled(self):
+        # 2 processes x 2 virtual devices each = a 4-device global mesh.
+        record, _ = run_cli(*TINY, "--launch", "2", "--n-virtual-cpu", "2",
+                            "--mesh", "seq=4", timeout=300)
+        assert record["n_devices"] == 4
+
+    def test_launch_multiprocess_train(self):
+        record, _ = run_cli(
+            "--mode", "train", "--device", "cpu", "--seq-len", "64",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "2", "--batch", "2",
+            "--dtype", "float32", "--iters", "1",
+            "--launch", "2", "--mesh", "data=2", timeout=300,
+        )
+        assert record["mode"] == "train" and len(record["losses"]) == 2
 
     def test_train_host_data_pipeline(self):
         record, logs = run_cli(

@@ -32,6 +32,68 @@ from tree_attention_tpu.utils.logging import get_logger, setup_logging
 log = get_logger("cli")
 
 
+def _pick_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
+    """``--launch N``: respawn this command as N coordinated processes.
+
+    The multi-host shape (``jax.distributed`` cluster, device pool spanning
+    processes) on one machine — the working version of the reference's
+    ``mp.spawn`` + hardcoded rendezvous (``model.py:20-21,165``). Uses the
+    native fork/exec launcher; ranks and the coordinator address travel by
+    environment (see :func:`initialize_distributed
+    <tree_attention_tpu.parallel.mesh.initialize_distributed>`).
+    """
+    from tree_attention_tpu.host_runtime import launch_local
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    # Strip --launch so children run the command directly.
+    child_args = []
+    skip = False
+    for a in args:
+        if skip:
+            skip = False
+            continue
+        if a == "--launch":
+            skip = True
+            continue
+        if a.startswith("--launch="):
+            continue
+        child_args.append(a)
+    cmd = [sys.executable, "-m", "tree_attention_tpu", *child_args]
+    log.info("launching %d coordinated processes: %s", cfg.launch, cmd)
+    # The coordinator address travels to the children via inherited env;
+    # restore the parent's env afterwards so a later in-process run doesn't
+    # find a stale coordinator.
+    prev = os.environ.get("TA_COORDINATOR")
+    os.environ["TA_COORDINATOR"] = f"localhost:{_pick_free_port()}"
+    try:
+        failures, statuses = launch_local(cmd, cfg.launch)
+    finally:
+        if prev is None:
+            del os.environ["TA_COORDINATOR"]
+        else:
+            os.environ["TA_COORDINATOR"] = prev
+    if failures:
+        log.error("launch: %d/%d ranks failed: %s", failures, cfg.launch,
+                  statuses)
+    return 1 if failures else 0
+
+
+def _emit(record: dict) -> None:
+    """Print the run's one JSON record — from process 0 only."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(json.dumps(record))
+
+
 def _configure_backend(cfg: RunConfig) -> None:
     """Pick the platform before any JAX backend initialises.
 
@@ -89,7 +151,7 @@ def _run_decode(cfg: RunConfig, mesh) -> int:
     )
     if res.peak_hbm_bytes:
         log.info("peak HBM: %.1f MiB", res.peak_hbm_bytes / 2**20)
-    print(res.as_json_line())
+    _emit(res.as_dict())
     return 0
 
 
@@ -97,7 +159,7 @@ def _run_bench(cfg: RunConfig, mesh) -> int:
     from tree_attention_tpu.bench.harness import run_bench
 
     record = run_bench(cfg, mesh)
-    print(json.dumps(record))
+    _emit(record)
     return 0
 
 
@@ -222,12 +284,12 @@ def _run_train(cfg: RunConfig, mesh) -> int:
         "train step: median %.4fs (%.0f tokens/s)",
         stats.median, toks / stats.median,
     )
-    print(json.dumps({
+    _emit({
         "mode": "train",
         "losses": losses,
         "tokens_per_sec": round(toks / stats.median, 1),
         **stats.as_dict(),
-    }))
+    })
     return 0
 
 
@@ -249,7 +311,7 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
     )
     toks = jax.block_until_ready(toks)
     log.info("generated %s tokens from a %s prompt", toks.shape, prompt.shape)
-    print(json.dumps({"mode": "generate", "tokens": toks.tolist()}))
+    _emit({"mode": "generate", "tokens": toks.tolist()})
     return 0
 
 
@@ -260,6 +322,8 @@ def main(argv: Optional[list] = None) -> int:
         log_file=cfg.log_file,
         all_processes=cfg.all_processes,
     )
+    if cfg.launch > 1:
+        return _relaunch(cfg, argv)
     _configure_backend(cfg)
 
     import jax

@@ -45,7 +45,35 @@ def initialize_distributed(
     skips initialisation whenever CUDA is missing). On a multi-host TPU slice
     arguments are usually auto-detected from the TPU metadata server, so
     calling with no arguments is correct there too.
+
+    A launcher can also configure the cluster by environment — the contract
+    :func:`tree_attention_tpu.host_runtime.launch_local` and the CLI's
+    ``--launch N`` use (the reference hardcodes its rendezvous env vars
+    instead, ``model.py:20-21``):
+
+    - ``TA_COORDINATOR``     — ``host:port`` of the rank-0 coordination
+      service (its presence is what opts in to distributed init);
+    - ``TA_NUM_PROCESSES``   — world size;
+    - ``JAX_PROCESS_INDEX``  — this process's rank.
     """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("TA_COORDINATOR")
+        if coordinator_address is not None:
+            missing = [
+                v for v in ("TA_NUM_PROCESSES", "JAX_PROCESS_INDEX")
+                if v not in os.environ
+            ]
+            if missing:
+                raise RuntimeError(
+                    "TA_COORDINATOR is set but the rest of the env contract "
+                    f"is missing: {missing} (a launcher must export the "
+                    "world size and this process's rank alongside the "
+                    "coordinator address)"
+                )
+            if num_processes is None:
+                num_processes = int(os.environ["TA_NUM_PROCESSES"])
+            if process_id is None:
+                process_id = int(os.environ["JAX_PROCESS_INDEX"])
     if num_processes is not None and num_processes > 1 or coordinator_address:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -52,6 +52,7 @@ class RunConfig:
     device: str = "auto"  # auto | tpu | cpu
     mesh: Optional[str] = None  # e.g. "seq=8" or "data=2,seq=2,model=2"
     n_virtual_cpu: int = 0  # >0: force N virtual CPU devices (tests/emulation)
+    launch: int = 0  # >1: respawn N coordinated processes (multi-host shape)
     impl: str = "auto"  # auto | naive | blockwise | pallas
     block_size: int = 512
     seed: int = 0
@@ -92,6 +93,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     d = RunConfig()
     p = argparse.ArgumentParser(
         prog="tree_attention_tpu",
+        # No abbreviations: --launch respawns the command with the flag
+        # stripped by literal match; an abbreviated form surviving the strip
+        # would recurse (and ambiguous prefixes are a footgun regardless).
+        allow_abbrev=False,
         description=(
             "TPU-native sequence-parallel tree attention driver. With no "
             "flags, reproduces the reference workload (decode over a "
@@ -105,6 +110,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="named mesh axes, e.g. seq=8 or data=2,seq=2,model=2")
     p.add_argument("--n-virtual-cpu", type=int, default=d.n_virtual_cpu,
                    metavar="N", help="emulate N CPU devices (forces --device=cpu)")
+    p.add_argument("--launch", type=int, default=d.launch, metavar="N",
+                   help="spawn N coordinated local processes (the multi-host "
+                        "shape: one jax.distributed cluster, devices pooled "
+                        "across processes) and run this command in each")
     p.add_argument("--batch", type=int, default=d.batch)
     p.add_argument("--seq-len", type=int, default=d.seq_len)
     p.add_argument("--q-len", type=int, default=d.q_len)
