@@ -317,9 +317,14 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
 
 def main(argv: Optional[list] = None) -> int:
     cfg = parse_args(argv)
+    # Under --launch, every child would otherwise open (and rotate) the same
+    # file, corrupting each other's sink — rank-suffix the children's path.
+    log_file = cfg.log_file
+    if log_file and os.environ.get("TA_COORDINATOR"):
+        log_file = f"{log_file}.p{os.environ.get('JAX_PROCESS_INDEX', '0')}"
     setup_logging(
         getattr(logging, cfg.log_level.upper()),
-        log_file=cfg.log_file,
+        log_file=log_file,
         all_processes=cfg.all_processes,
     )
     if cfg.launch > 1:

@@ -10,10 +10,10 @@ done right). Implementations:
   bwd (:mod:`.pallas_bwd`)
 - ``"auto"``      — small-Tq MHA decode shapes resolve to ``naive`` (the
   fused two-matmul form runs nearest the HBM roofline there, and its raw
-  autodiff is fine for inference); everything else is blockwise, resolving
-  to pallas on TPU only when ``TREE_ATTN_AUTO_PALLAS=1`` (opt-in until the
-  kernel is verified on the target chip). Pass an explicit impl when the
-  O(T)-residual custom-VJP backward or a specific kernel must be used.
+  autodiff is fine for inference); otherwise pallas on TPU (verified
+  correct and fastest on-chip; ``TREE_ATTN_AUTO_PALLAS=0`` opts out) and
+  blockwise elsewhere. Pass an explicit impl when a specific kernel or
+  backward path must be used.
 """
 
 from __future__ import annotations
@@ -34,11 +34,50 @@ from tree_attention_tpu.ops.reference import (  # noqa: F401
 _IMPLS = ("auto", "naive", "blockwise", "pallas")
 
 
-def _on_tpu() -> bool:
+def _on_tpu(q=None) -> bool:
+    """Whether this computation targets TPU.
+
+    A concrete array's placement is authoritative (a CPU-placed array on a
+    TPU-default host must not select the Mosaic kernel); tracers carry no
+    devices, so jit callers fall back to the default backend — sharded entry
+    points resolve from their mesh instead (see ``parallel/tree.py``).
+    """
+    if q is not None and not isinstance(q, jax.core.Tracer):
+        try:
+            return {d.platform for d in q.devices()} == {"tpu"}
+        except Exception:
+            pass
     try:
         return jax.default_backend() == "tpu"
     except RuntimeError:  # no backends initialised
         return False
+
+
+def resolve_impl_for_mesh(impl: str, mesh) -> str:
+    """Pin ``impl='auto'`` for computations running on ``mesh``'s devices.
+
+    Inside ``shard_map``/``jit`` the arrays are tracers, so
+    :func:`flash_attention`'s own auto resolution can only consult the
+    default backend — wrong when the mesh lives on a different platform
+    (e.g. an emulated CPU mesh on a TPU-default host). Sharded entry points
+    call this with their mesh before tracing: when the mesh's platform is
+    the default backend (or TPU, where every auto branch is valid), "auto"
+    passes through; otherwise the portable blockwise path is pinned.
+    """
+    if impl != "auto":
+        return impl
+    try:
+        platforms = {d.platform for d in mesh.devices.flat}
+    except Exception:
+        return impl
+    if platforms == {"tpu"}:
+        return impl
+    try:
+        if platforms == {jax.default_backend()}:
+            return impl
+    except RuntimeError:
+        pass
+    return "blockwise"
 
 
 def _pallas_available() -> bool:
@@ -86,17 +125,18 @@ def flash_attention(
     if impl not in _IMPLS:
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "auto":
-        # Pallas-on-TPU stays opt-in until verified on the target chip (the
-        # current axon tunnel wedges in Mosaic compile — see
-        # .claude/skills/verify/SKILL.md); the XLA blockwise path is the safe
-        # default everywhere — except MHA decode shapes, where the
-        # materialised path wins: at tiny Tq the score matrix is a few MB,
-        # and fusing two large matmuls without a scan runs at ~95% of HBM
-        # roofline on v5e vs ~81% for the blockwise scan (measured, 64k ctx).
-        # Gated on Hq == Hkv because attention_naive expands GQA KV to Hq
-        # heads (group-factor HBM blowup the blockwise path avoids), and on
-        # 3x the score bytes (f32 logits + masked copy + probabilities are
-        # each materialised) staying comfortably small.
+        # Resolution order, all measured on the target chip (TPU v5e):
+        # 1. MHA decode shapes -> "naive": at tiny Tq the score matrix is a
+        #    few MB, and the fused two-matmul form runs at ~95% of HBM
+        #    roofline vs ~81% for the blockwise scan (64k ctx). Gated on
+        #    Hq == Hkv (attention_naive expands GQA KV to Hq heads — a
+        #    group-factor HBM blowup the other paths avoid) and on 3x the
+        #    score bytes (f32 logits + masked copy + probabilities all
+        #    materialise) staying comfortably small.
+        # 2. TPU -> "pallas": verified correct on-chip and ~4x the blockwise
+        #    fwd throughput / ~2.3x fwd+bwd (bf16 operands on the MXU fast
+        #    path, f32 accumulation). TREE_ATTN_AUTO_PALLAS=0 opts out.
+        # 3. Everywhere else -> "blockwise" (pure XLA, any backend).
         Tq, Tk = q.shape[2], k.shape[2]
         transient_bytes = 3 * q.shape[0] * q.shape[1] * Tq * Tk * 4
         if (
@@ -106,8 +146,8 @@ def flash_attention(
         ):
             impl = "naive"
         elif (
-            os.environ.get("TREE_ATTN_AUTO_PALLAS") == "1"
-            and _on_tpu()
+            os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
+            and _on_tpu(q)
             and _pallas_available()
         ):
             impl = "pallas"

@@ -78,12 +78,15 @@ def _flash_fwd_kernel(
 
     @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _compute():
+        # Operands stay in their native dtype (bf16 hits the MXU's fast
+        # path; casting to f32 first would quarter matmul throughput) with
+        # f32 accumulation via preferred_element_type.
         s = lax.dot_general(
-            q_ref[0].astype(jnp.float32),
-            k_ref[0].astype(jnp.float32),
+            q_ref[0],
+            k_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk)
+        ) * scale  # (bq, bk) f32
 
         valid = col_idx < tk  # mask host-side padding of ragged Tk
         if causal:
@@ -98,8 +101,11 @@ def _flash_fwd_kernel(
         alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
         p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # P is cast to V's dtype for the second MXU matmul (the FA2 trick:
+        # probabilities are in [0,1] so bf16 relative error stays small) and
+        # accumulated in f32.
         acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
-            p, v_ref[0].astype(jnp.float32),
+            p.astype(v_ref.dtype), v_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )

@@ -41,7 +41,11 @@ from tree_attention_tpu.ops.block_utils import LANES as _LANES, NEG_INF
 
 def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
                     row_pos, col_idx, col_pos, tk):
-    """p and ds for one (Q-tile, KV-tile) pair, f32."""
+    """p and ds for one (Q-tile, KV-tile) pair, f32 results.
+
+    Matmul operands stay in their storage dtype (bf16 rides the MXU fast
+    path; a prior f32 upcast quarters throughput) and accumulate in f32.
+    """
     s = lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -77,16 +81,16 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _():
-        kf = k_ref[0].astype(jnp.float32)
         _, ds = _recompute_p_ds(
-            q_ref[0].astype(jnp.float32), kf, v_ref[0].astype(jnp.float32),
-            do_ref[0].astype(jnp.float32), lse_ref[0][:, :1],
+            q_ref[0], k_ref[0], v_ref[0],
+            do_ref[0], lse_ref[0][:, :1],
             delta_ref[0][:, :1],
             scale=scale, causal=causal,
             row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
         )
         dq_scr[...] += lax.dot_general(
-            ds, kf, dimension_numbers=(((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
@@ -116,20 +120,20 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _():
-        qf = q_ref[0].astype(jnp.float32)
-        dof = do_ref[0].astype(jnp.float32)
         p, ds = _recompute_p_ds(
-            qf, k_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
-            dof, lse_ref[0][:, :1], delta_ref[0][:, :1],
+            q_ref[0], k_ref[0], v_ref[0],
+            do_ref[0], lse_ref[0][:, :1], delta_ref[0][:, :1],
             scale=scale, causal=causal,
             row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
         )
         dk_scr[...] += lax.dot_general(
-            ds, qf, dimension_numbers=(((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         dv_scr[...] += lax.dot_general(
-            p, dof, dimension_numbers=(((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 shard_map = jax.shard_map
 
-from tree_attention_tpu.ops import flash_attention
+from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
 from tree_attention_tpu.ops.reference import NEG_INF
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
@@ -126,6 +126,7 @@ def tree_decode(
             f"'{seq_axis}' shards"
         )
     Tk_local = Tk_global // n_shards
+    impl = resolve_impl_for_mesh(impl, mesh)
 
     q_spec = P(data_axis, head_axis, None, None)
     kv_spec = P(data_axis, head_axis, seq_axis, None)
@@ -194,6 +195,7 @@ def tree_attention(
         )
     Tq_local = Tq_global // n_shards
     Tk_local = k.shape[2] // n_shards
+    impl = resolve_impl_for_mesh(impl, mesh)
 
     spec = P(data_axis, head_axis, seq_axis, None)
     lse_spec = P(data_axis, head_axis, seq_axis)
