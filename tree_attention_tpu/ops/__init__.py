@@ -126,27 +126,27 @@ def flash_attention(
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "auto":
         # Resolution order, all measured on the target chip (TPU v5e):
-        # 1. MHA decode shapes -> "naive": at tiny Tq the score matrix is a
-        #    few MB, and the fused two-matmul form runs at ~95% of HBM
-        #    roofline vs ~81% for the blockwise scan (64k ctx). Gated on
-        #    Hq == Hkv (attention_naive expands GQA KV to Hq heads — a
-        #    group-factor HBM blowup the other paths avoid) and on 3x the
+        # 1. Decode shapes -> "naive": at tiny Tq the score matrix is a few
+        #    MB, and the fused two-matmul form runs at ~95% of HBM roofline
+        #    vs ~81% for the blockwise scan (64k ctx). GQA costs nothing
+        #    extra (grouped einsums, KV never expanded). Gated on 3x the
         #    score bytes (f32 logits + masked copy + probabilities all
         #    materialise) staying comfortably small.
-        # 2. TPU -> "pallas": verified correct on-chip and ~4x the blockwise
-        #    fwd throughput / ~2.3x fwd+bwd (bf16 operands on the MXU fast
-        #    path, f32 accumulation). TREE_ATTN_AUTO_PALLAS=0 opts out.
-        # 3. Everywhere else -> "blockwise" (pure XLA, any backend).
+        # 2. Large-Tq shapes on TPU -> "pallas": verified correct on-chip
+        #    and ~4x the blockwise fwd throughput / ~2.3x fwd+bwd (bf16
+        #    operands on the MXU fast path, f32 accumulation). Gated on
+        #    Tq >= 128: with fewer query rows the kernel's Q tiles starve
+        #    the MXU and the blockwise scan wins (1M-ctx decode measured
+        #    0.64 TB/s blockwise vs 0.10 TB/s pallas).
+        #    TREE_ATTN_AUTO_PALLAS=0 opts out.
+        # 3. Everything else -> "blockwise" (pure XLA, any backend).
         Tq, Tk = q.shape[2], k.shape[2]
         transient_bytes = 3 * q.shape[0] * q.shape[1] * Tq * Tk * 4
-        if (
-            Tq <= 8
-            and q.shape[1] == k.shape[1]
-            and transient_bytes <= 128 * 1024 * 1024
-        ):
+        if Tq <= 8 and transient_bytes <= 128 * 1024 * 1024:
             impl = "naive"
         elif (
-            os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
+            Tq >= 128
+            and os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
             and _on_tpu(q)
             and _pallas_available()
         ):

@@ -44,22 +44,6 @@ from jax import lax
 from tree_attention_tpu.ops.block_utils import NEG_INF  # noqa: F401  (canonical home)
 
 
-def _expand_gqa(k: jax.Array, v: jax.Array, num_q_heads: int) -> Tuple[jax.Array, jax.Array]:
-    """Repeat KV heads up to the query head count for grouped-query attention."""
-    num_kv_heads = k.shape[1]
-    if num_kv_heads == num_q_heads:
-        return k, v
-    if num_q_heads % num_kv_heads != 0:
-        raise ValueError(
-            f"query heads ({num_q_heads}) must be a multiple of kv heads ({num_kv_heads})"
-        )
-    group = num_q_heads // num_kv_heads
-    return (
-        jnp.repeat(k, group, axis=1),
-        jnp.repeat(v, group, axis=1),
-    )
-
-
 def _default_scale(head_dim: int, scale: Optional[float]) -> float:
     return (head_dim ** -0.5) if scale is None else scale
 
@@ -104,10 +88,21 @@ def attention_naive(
     q_offset=0,
     kv_offset=0,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Materialised-scores attention. Oracle implementation for tests."""
+    """Materialised-scores attention. Oracle implementation for tests.
+
+    GQA is grouped, not expanded: query head ``h`` reads KV head ``h // G``
+    through a reshape (``(B, Hkv, G, Tq, D)``) and grouped einsums, so KV is
+    never replicated in memory — the same mapping the Pallas kernel's
+    BlockSpec index does in VMEM. That keeps this path viable for big GQA
+    decode caches, not just as a test oracle.
+    """
     B, Hq, Tq, D = q.shape
-    k, v = _expand_gqa(k, v, Hq)
-    Tk = k.shape[2]
+    Hkv, Tk = k.shape[1], k.shape[2]
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
     s = _default_scale(D, scale)
 
     if Tk == 0:  # empty shard contributes the safe-softmax identity
@@ -116,12 +111,13 @@ def attention_naive(
             jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),
         )
 
+    qg = q.reshape(B, Hkv, G, Tq, D)
     logits = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
     ) * s
     if causal:
         mask = _causal_mask(Tq, Tk, q_offset, kv_offset)
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        logits = jnp.where(mask[None, None, None], logits, NEG_INF)
 
     m = jnp.max(logits, axis=-1)
     # exp(-inf - -inf) would be nan; fully-masked rows get m := 0 so that
@@ -129,8 +125,13 @@ def attention_naive(
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
     p = jnp.exp(logits - m_safe[..., None])
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    return finalize(acc, m, l, q.dtype)
+    acc = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    return finalize(
+        acc.reshape(B, Hq, Tq, D),
+        m.reshape(B, Hq, Tq),
+        l.reshape(B, Hq, Tq),
+        q.dtype,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_size"))
