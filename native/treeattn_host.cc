@@ -17,6 +17,8 @@
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
+#include <cerrno>
+
 #include <atomic>
 #include <cmath>
 #include <condition_variable>
@@ -255,6 +257,12 @@ int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
     pid_t pid = fork();
     if (pid < 0) {
       for (int k = 0; k < r; ++k) kill(pids[k], SIGTERM);
+      // Reap the killed children: a long-lived host process accumulating
+      // zombies from failed launches would eventually exhaust the pid table.
+      for (int k = 0; k < r; ++k) {
+        int st = 0;
+        while (waitpid(pids[k], &st, 0) < 0 && errno == EINTR) {}
+      }
       return -1;
     }
     if (pid == 0) {
@@ -266,8 +274,13 @@ int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
   int failures = 0;
   for (int r = 0; r < nprocs; ++r) {
     int st = 0;
-    waitpid(pids[r], &st, 0);
-    const int code = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
+    pid_t w;
+    while ((w = waitpid(pids[r], &st, 0)) < 0 && errno == EINTR) {}
+    // A persistent waitpid error means the rank's status is unknown; report
+    // it as a failure rather than defaulting st=0 to "exited cleanly".
+    const int code = (w < 0) ? 255
+                             : WIFEXITED(st) ? WEXITSTATUS(st)
+                                             : 128 + WTERMSIG(st);
     if (statuses) statuses[r] = code;
     if (code != 0) ++failures;
   }

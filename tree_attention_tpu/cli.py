@@ -198,6 +198,10 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     )
     from tree_attention_tpu.utils.profiling import time_fn
 
+    if cfg.steps < 1:
+        # Throughput timing below reuses the last training batch; with no
+        # steps there is neither a batch nor anything meaningful to time.
+        raise SystemExit("train mode requires --steps >= 1")
     tcfg = _transformer_config(cfg)
     opt = default_optimizer()
     state = init_train_state(jax.random.PRNGKey(cfg.seed), tcfg, opt, mesh=mesh)
@@ -263,7 +267,7 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             log.info("step %d: loss %.4f", i, losses[-1])
             if ckpt is not None:
                 saved_last = ckpt.save(i, state, cfg=tcfg)
-        if ckpt is not None and cfg.steps > 0 and not saved_last:
+        if ckpt is not None and not saved_last:
             # The save interval skipped the final step; the resumable state
             # must include all completed work.
             ckpt.save(start + cfg.steps - 1, state, cfg=tcfg, force=True)
@@ -298,16 +302,19 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
 
     from tree_attention_tpu.models import generate, init_params
 
+    if cfg.temperature < 0:
+        raise SystemExit("--temperature must be >= 0 (0 = greedy)")
     tcfg = _transformer_config(cfg)
     params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
     prompt = jax.random.randint(
         jax.random.PRNGKey(cfg.seed + 1), (cfg.batch, max(cfg.q_len, 1)),
         0, tcfg.vocab_size,
     )
-    n_new = min(cfg.seq_len, 32)
+    n_new = cfg.max_new_tokens
     toks = generate(
         params, prompt, n_new, tcfg,
-        temperature=0.8, key=jax.random.PRNGKey(cfg.seed + 2), mesh=mesh,
+        temperature=cfg.temperature, key=jax.random.PRNGKey(cfg.seed + 2),
+        mesh=mesh,
     )
     toks = jax.block_until_ready(toks)
     log.info("generated %s tokens from a %s prompt", toks.shape, prompt.shape)

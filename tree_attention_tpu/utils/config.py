@@ -68,6 +68,10 @@ class RunConfig:
     n_layers: int = 2
     vocab_size: int = 4096
 
+    # Generate mode.
+    temperature: float = 0.8
+    max_new_tokens: int = 32
+
     # Host data pipeline (train mode).
     host_data: bool = False
 
@@ -136,6 +140,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-dim", type=int, default=d.model_dim)
     p.add_argument("--n-layers", type=int, default=d.n_layers)
     p.add_argument("--vocab-size", type=int, default=d.vocab_size)
+    p.add_argument("--temperature", type=float, default=d.temperature,
+                   help="generate mode: sampling temperature (0 = greedy)")
+    p.add_argument("--max-new-tokens", type=int, default=d.max_new_tokens,
+                   help="generate mode: number of tokens to sample")
     p.add_argument("--host-data", action="store_true", default=d.host_data,
                    help="train mode: feed batches from the native prefetching "
                         "host pipeline instead of on-device RNG")

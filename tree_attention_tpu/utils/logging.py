@@ -60,7 +60,10 @@ def _process_index() -> int:
             pass
     env = os.environ.get("JAX_PROCESS_INDEX")
     if env is not None:
-        return int(env)
+        try:
+            return int(env)
+        except ValueError:
+            pass  # malformed export: fall through to the rank-0 default
     if jax_mod is not None and _backend_initialized():
         try:
             return jax_mod.process_index()
