@@ -256,9 +256,11 @@ int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
   for (int r = 0; r < nprocs; ++r) {
     pid_t pid = fork();
     if (pid < 0) {
-      for (int k = 0; k < r; ++k) kill(pids[k], SIGTERM);
-      // Reap the killed children: a long-lived host process accumulating
-      // zombies from failed launches would eventually exhaust the pid table.
+      // SIGKILL, not SIGTERM: nothing graceful is owed on a failed launch,
+      // and a rank that catches/masks SIGTERM would block the reap below
+      // forever. Reaping matters: a long-lived host process accumulating
+      // zombies from failed launches would exhaust the pid table.
+      for (int k = 0; k < r; ++k) kill(pids[k], SIGKILL);
       for (int k = 0; k < r; ++k) {
         int st = 0;
         while (waitpid(pids[k], &st, 0) < 0 && errno == EINTR) {}

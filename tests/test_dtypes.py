@@ -1,0 +1,158 @@
+"""Dtype-tiered numerics lane: {float32, bfloat16, float16} × every impl.
+
+SURVEY.md §7 hard part 3: the reference ran fp16 (``model.py:51``), TPU-native
+half is bf16, and the oracle contract is "matches torch SDPA" with per-dtype
+tolerances. One tolerance table, every impl (naive / blockwise /
+pallas-interpret / pallas_decode-interpret / the custom-VJP backward / the
+sharded tree paths) exercised in every dtype.
+
+Tolerance rationale: f32 inputs run exact-precision contractions
+(``ops.block_utils.matmul_precision``); bf16 has ~8 mantissa bits (rel err
+~4e-3 per element, amplified by the value contraction); f16 has ~11 mantissa
+bits but less range — on TPU its matmuls pass through the bf16 MXU path, so
+its practical tier sits between bf16 and f32.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tree_attention_tpu.ops import attention_naive, flash_attention
+from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+from tests.oracles import sdpa_grads, sdpa_out_lse
+
+DTYPES = {
+    "float32": (jnp.float32, 2e-5),
+    "bfloat16": (jnp.bfloat16, 5e-2),
+    "float16": (jnp.float16, 2e-2),
+}
+# lse is computed in f32 from f32 logits in every impl; only input rounding
+# contributes, so its tiers are tighter than the value-contraction tiers.
+LSE_TOL = {"float32": 2e-5, "bfloat16": 2e-2, "float16": 6e-3}
+
+
+def make_qkv(rng, dtype, B=1, Hq=4, Hkv=2, Tq=16, Tk=192, D=32):
+    q = rng.standard_normal((B, Hq, Tq, D), np.float32) * 0.5
+    k = rng.standard_normal((B, Hkv, Tk, D), np.float32) * 0.5
+    v = rng.standard_normal((B, Hkv, Tk, D), np.float32) * 0.5
+    return q, k, v, (
+        jnp.asarray(q, dtype), jnp.asarray(k, dtype), jnp.asarray(v, dtype)
+    )
+
+
+@pytest.mark.parametrize("name", DTYPES)
+@pytest.mark.parametrize(
+    "impl", ["naive", "blockwise", "pallas", "pallas_decode"]
+)
+def test_forward_vs_torch_sdpa(name, impl):
+    dtype, tol = DTYPES[name]
+    rng = np.random.default_rng(0)
+    q, k, v, (qj, kj, vj) = make_qkv(rng, dtype)
+    # Bottom-right causal alignment on both sides (the oracle's default).
+    q_off = k.shape[2] - q.shape[2]
+    ref_out, ref_lse = sdpa_out_lse(q, k, v, causal=True)
+    out, lse = flash_attention(
+        qj, kj, vj, causal=True, q_offset=q_off, impl=impl, block_size=64,
+        custom_vjp=False,
+    )
+    assert out.dtype == dtype
+    assert lse.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref_out, atol=tol, rtol=tol
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse), ref_lse, atol=LSE_TOL[name], rtol=LSE_TOL[name]
+    )
+
+
+@pytest.mark.parametrize("name", DTYPES)
+def test_decode_shape_vs_torch_sdpa(name):
+    """The reference workload shape (Tq=1 against a long KV) per dtype —
+    the reference itself ran this in fp16 (model.py:51-53)."""
+    dtype, tol = DTYPES[name]
+    rng = np.random.default_rng(1)
+    q, k, v, (qj, kj, vj) = make_qkv(rng, dtype, Hq=8, Hkv=8, Tq=1, Tk=1000, D=64)
+    ref_out, _ = sdpa_out_lse(q, k, v, causal=False)
+    out, _ = attention_pallas_decode(qj, kj, vj, block_size=256)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref_out, atol=tol, rtol=tol
+    )
+    out_n, _ = attention_naive(qj, kj, vj)
+    np.testing.assert_allclose(
+        np.asarray(out_n, np.float32), ref_out, atol=tol, rtol=tol
+    )
+
+
+GRAD_TOL = {"float32": 3e-5, "bfloat16": 6e-2, "float16": 2e-2}
+
+
+@pytest.mark.parametrize("name", DTYPES)
+@pytest.mark.parametrize("impl", ["blockwise", "pallas"])
+def test_grads_vs_torch_sdpa(name, impl):
+    """Flash custom-VJP backward matches torch autograd per dtype."""
+    dtype, _ = DTYPES[name]
+    tol = GRAD_TOL[name]
+    rng = np.random.default_rng(2)
+    q, k, v, (qj, kj, vj) = make_qkv(rng, dtype, Hq=4, Hkv=4, Tq=64, Tk=64)
+    dout = rng.standard_normal(q.shape, np.float32) * 0.5
+    ref_dq, ref_dk, ref_dv = sdpa_grads(q, k, v, dout, causal=True)
+
+    def loss(q_, k_, v_):
+        o, _ = flash_attention(q_, k_, v_, causal=True, impl=impl, block_size=64)
+        return jnp.sum(o.astype(jnp.float32) * jnp.asarray(dout))
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(qj, kj, vj)
+    for g, ref in ((dq, ref_dq), (dk, ref_dk), (dv, ref_dv)):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), ref, atol=tol, rtol=tol
+        )
+
+
+@pytest.mark.parametrize("name", ["bfloat16", "float16"])
+def test_tree_decode_sharded_half_precision(name):
+    """The sharded tree merge in half precision: merge currency (lse, num,
+    den) stays f32, so sharded == unsharded to the dtype's own tier."""
+    from tree_attention_tpu.parallel import cpu_mesh, tree_decode
+
+    dtype, tol = DTYPES[name]
+    rng = np.random.default_rng(3)
+    q, k, v, (qj, kj, vj) = make_qkv(rng, dtype, Hq=4, Hkv=4, Tq=1, Tk=512, D=32)
+    mesh = cpu_mesh(4)
+    out, lse = tree_decode(qj, kj, vj, mesh=mesh, impl="blockwise")
+    ref_out, ref_lse = attention_naive(qj, kj, vj)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_out, np.float32),
+        atol=tol, rtol=tol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse), atol=LSE_TOL[name],
+        rtol=LSE_TOL[name],
+    )
+
+
+def test_fp16_cli_decode_end_to_end():
+    """--dtype float16 through the CLI decode path (accepted but previously
+    untested; VERDICT round-1 missing item 5)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tree_attention_tpu", "--mode", "decode",
+         "--device", "cpu", "--seq-len", "512", "--heads", "4",
+         "--head-dim", "32", "--dtype", "float16", "--iters", "2"],
+        capture_output=True, text=True, timeout=300, cwd=repo, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = next(
+        json.loads(l) for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    )
+    assert rec["workload"]["dtype"] == "float16"
+    assert rec["tokens_per_sec"] > 0

@@ -1,0 +1,152 @@
+"""Pallas split-KV flash-decode kernel tests (interpret mode on CPU — same
+kernel code the TPU compiles; real-TPU parity is exercised by bench.py on
+hardware). Mirrors tests/test_pallas_fwd.py for the small-Tq regime."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from tree_attention_tpu.ops import attention_naive, merge_partials
+from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+
+def make_qkv(rng, B=1, Hq=4, Hkv=4, Tq=1, Tk=1024, D=64, dtype=np.float32):
+    q = rng.standard_normal((B, Hq, Tq, D), np.float32).astype(dtype)
+    k = rng.standard_normal((B, Hkv, Tk, D), np.float32).astype(dtype)
+    v = rng.standard_normal((B, Hkv, Tk, D), np.float32).astype(dtype)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_naive(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = make_qkv(rng)
+    out, lse = attention_pallas_decode(
+        q, k, v, causal=causal, q_offset=1023, block_size=256
+    )
+    ref_out, ref_lse = attention_naive(q, k, v, causal=causal, q_offset=1023)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("tq,tk", [(1, 1000), (4, 777), (7, 5), (16, 2048)])
+def test_ragged_lengths(tq, tk):
+    """Tk not a multiple of the tile size (and Tk < min sublane tile)."""
+    rng = np.random.default_rng(1)
+    q, k, v = make_qkv(rng, Tq=tq, Tk=tk)
+    out, lse = attention_pallas_decode(
+        q, k, v, causal=True, q_offset=max(0, tk - tq), block_size=256
+    )
+    ref_out, ref_lse = attention_naive(q, k, v, causal=True, q_offset=max(0, tk - tq))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("hq,hkv,tq", [(16, 4, 1), (8, 1, 3), (8, 2, 16)])
+def test_gqa_lane_packing(hq, hkv, tq):
+    """The group × Tq lane packing maps each query to its own KV head."""
+    rng = np.random.default_rng(2)
+    q, k, v = make_qkv(rng, Hq=hq, Hkv=hkv, Tq=tq, Tk=640)
+    out, lse = attention_pallas_decode(
+        q, k, v, causal=True, q_offset=640 - tq, block_size=256
+    )
+    ref_out, ref_lse = attention_naive(q, k, v, causal=True, q_offset=640 - tq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+def test_lane_overflow_multi_tile_r():
+    """G·Tq > 128 packs into more than one lane tile."""
+    rng = np.random.default_rng(6)
+    q, k, v = make_qkv(rng, Hq=8, Hkv=2, Tq=40, Tk=512, D=32)  # r = 160
+    out, lse = attention_pallas_decode(
+        q, k, v, causal=True, q_offset=512 - 40, block_size=256
+    )
+    ref_out, ref_lse = attention_naive(q, k, v, causal=True, q_offset=512 - 40)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+def test_sharded_offsets_fully_masked_shard():
+    """kv_offset puts the whole shard in the causal future -> identity."""
+    rng = np.random.default_rng(3)
+    q, k, v = make_qkv(rng, Tq=2, Tk=128, D=32)
+    out, lse = attention_pallas_decode(
+        q, k, v, causal=True, q_offset=0, kv_offset=10_000, block_size=64
+    )
+    assert np.all(np.asarray(out) == 0.0)
+    assert np.all(np.isneginf(np.asarray(lse)))
+
+
+def test_merge_partials_across_shards():
+    """Per-shard kernel (out, lse) recombines into the unsharded result —
+    the decode kernel feeding the tree merge (the product's data path)."""
+    rng = np.random.default_rng(7)
+    q, k, v = make_qkv(rng, Hq=8, Hkv=2, Tq=1, Tk=1024)
+    ref_out, ref_lse = attention_naive(q, k, v)
+    S = 4
+    outs, lses = [], []
+    for i in range(S):
+        sl = slice(i * 256, (i + 1) * 256)
+        o, l = attention_pallas_decode(
+            q, k[:, :, sl], v[:, :, sl], block_size=128
+        )
+        outs.append(o)
+        lses.append(l)
+    out, lse = merge_partials(jnp.stack(outs), jnp.stack(lses))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+def test_bf16():
+    rng = np.random.default_rng(4)
+    q, k, v = make_qkv(rng, Tk=512)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out, lse = attention_pallas_decode(qb, kb, vb, block_size=256)
+    ref_out, _ = attention_naive(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_out), atol=5e-2, rtol=5e-2
+    )
+
+
+def test_traced_q_position():
+    """q_offset may be a traced scalar (jitted decode steps reuse one trace)."""
+    import jax
+
+    rng = np.random.default_rng(5)
+    q, k, v = make_qkv(rng, Tq=1, Tk=256, D=32)
+
+    @jax.jit
+    def step(q, k, v, pos):
+        return attention_pallas_decode(
+            q, k, v, causal=True, q_offset=pos, block_size=128
+        )
+
+    for pos in (0, 100, 255):
+        out, lse = step(q, k, v, jnp.asarray(pos, jnp.int32))
+        ref_out, ref_lse = attention_naive(q, k, v, causal=True, q_offset=pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+def test_dispatcher_impl_pallas_decode_grads():
+    """flash_attention(impl='pallas_decode'): kernel fwd + blockwise bwd."""
+    import jax
+    from tree_attention_tpu.ops import flash_attention
+
+    rng = np.random.default_rng(8)
+    q, k, v = make_qkv(rng, Tq=4, Tk=256, D=32)
+
+    def loss(impl):
+        def f(q_, k_, v_):
+            o, lse = flash_attention(
+                q_, k_, v_, causal=True, q_offset=252, impl=impl
+            )
+            return jnp.sum(o ** 2) + jnp.sum(lse)
+        return f
+
+    g_p = jax.grad(loss("pallas_decode"), argnums=(0, 1, 2))(q, k, v)
+    g_n = jax.grad(loss("naive"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_p, g_n):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5)

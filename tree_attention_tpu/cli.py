@@ -304,6 +304,8 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
 
     if cfg.temperature < 0:
         raise SystemExit("--temperature must be >= 0 (0 = greedy)")
+    if cfg.max_new_tokens < 1:
+        raise SystemExit("--max-new-tokens must be >= 1")
     tcfg = _transformer_config(cfg)
     params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
     prompt = jax.random.randint(

@@ -265,7 +265,7 @@ def decode_attention(
     model_axis: Optional[str] = AXIS_MODEL,
     impl: str = "auto",
     num_splits: Optional[int] = None,
-    block_size: int = 512,
+    block_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Op-level decode entry: split-KV on one device, tree merge on a mesh.
 

@@ -58,7 +58,7 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16    # activation/param compute dtype
     attn_impl: str = "auto"      # flash_attention impl selector
-    attn_block_size: int = 512
+    attn_block_size: Optional[int] = None  # None -> impl-appropriate
     remat: bool = True           # checkpoint each layer body under scan
 
     def __post_init__(self):

@@ -7,13 +7,15 @@ done right). Implementations:
 - ``"naive"``     — materialised scores, test oracle (:mod:`.reference`)
 - ``"blockwise"`` — online-softmax ``lax.scan``, any backend (:mod:`.reference`)
 - ``"pallas"``    — Pallas TPU kernels, fwd (:mod:`.pallas_attention`) +
-  bwd (:mod:`.pallas_bwd`)
-- ``"auto"``      — small-Tq MHA decode shapes resolve to ``naive`` (the
-  fused two-matmul form runs nearest the HBM roofline there, and its raw
-  autodiff is fine for inference); otherwise pallas on TPU (verified
-  correct and fastest on-chip; ``TREE_ATTN_AUTO_PALLAS=0`` opts out) and
-  blockwise elsewhere. Pass an explicit impl when a specific kernel or
-  backward path must be used.
+  bwd (:mod:`.pallas_bwd`); Q-tiled, the training shape
+- ``"pallas_decode"`` — Pallas TPU split-KV flash-decode kernel
+  (:mod:`.pallas_decode`); KV-major layout for Tq < 128
+- ``"auto"``      — decode shapes (Tq < 128) resolve to the flash-decode
+  kernel on TPU (any context length; no score transient) and to ``naive``
+  elsewhere when the score transient is small; large-Tq shapes resolve to
+  ``pallas`` on TPU (``TREE_ATTN_AUTO_PALLAS=0`` opts out of both kernels)
+  and ``blockwise`` elsewhere. Pass an explicit impl when a specific kernel
+  or backward path must be used.
 """
 
 from __future__ import annotations
@@ -31,7 +33,7 @@ from tree_attention_tpu.ops.reference import (  # noqa: F401
     merge_partials,
 )
 
-_IMPLS = ("auto", "naive", "blockwise", "pallas")
+_IMPLS = ("auto", "naive", "blockwise", "pallas", "pallas_decode")
 
 
 def _on_tpu(q=None) -> bool:
@@ -98,7 +100,7 @@ def flash_attention(
     q_offset=0,
     kv_offset=0,
     impl: str = "auto",
-    block_size: int = 512,
+    block_size: Optional[int] = None,
     custom_vjp: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Compute attention over the sequence axis, returning ``(out, lse)``.
@@ -110,8 +112,11 @@ def flash_attention(
       scale: logit scale; default ``D**-0.5``.
       q_offset / kv_offset: global positions of the first local query/key row,
         for causal masking across sequence shards.
-      impl: ``auto | naive | blockwise | pallas``.
-      block_size: KV block length for the blockwise/pallas paths.
+      impl: ``auto | naive | blockwise | pallas | pallas_decode``.
+      block_size: KV block length for the blockwise/pallas paths. ``None``
+        picks the impl's own tuned default (512 for blockwise/pallas, 2048
+        for the flash-decode kernel — its tiles are pure streaming, bigger
+        amortises better); an explicit value is honored as given.
       custom_vjp: use the flash (recompute-from-lse) backward — O(T) residual
         memory but **reverse-mode only** (``jax.jvp``/``jacfwd`` raise on
         custom_vjp functions). Pass False (or ``impl='naive'``) for
@@ -126,45 +131,53 @@ def flash_attention(
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "auto":
         # Resolution order, all measured on the target chip (TPU v5e):
-        # 1. Decode shapes -> "naive": at tiny Tq the score matrix is a few
-        #    MB, and the fused two-matmul form runs at ~95% of HBM roofline
-        #    vs ~81% for the blockwise scan (64k ctx). GQA costs nothing
-        #    extra (grouped einsums, KV never expanded). Gated on 3x the
-        #    score bytes (f32 logits + masked copy + probabilities all
-        #    materialise) staying comfortably small.
-        # 2. Large-Tq shapes on TPU -> "pallas": verified correct on-chip
-        #    and ~4x the blockwise fwd throughput / ~2.3x fwd+bwd (bf16
-        #    operands on the MXU fast path, f32 accumulation). Gated on
-        #    Tq >= 128: with fewer query rows the kernel's Q tiles starve
-        #    the MXU and the blockwise scan wins (1M-ctx decode measured
-        #    0.64 TB/s blockwise vs 0.10 TB/s pallas).
-        #    TREE_ATTN_AUTO_PALLAS=0 opts out.
-        # 3. Everything else -> "blockwise" (pure XLA, any backend).
+        # 1. Decode shapes (Tq < 128) on TPU -> "pallas_decode": the KV-major
+        #    split-KV kernel streams KV at the HBM roofline regardless of
+        #    context length (no score transient, GQA streams each KV head
+        #    once). This removes round 1's cliff where >=683k-token MHA
+        #    decode fell off the naive path's 128 MB transient gate.
+        # 2. Decode shapes elsewhere -> "naive" when the score transient is
+        #    small (fused two-matmul form; raw autodiff fine for inference).
+        #    Gated on 3x the score bytes (f32 logits + masked copy +
+        #    probabilities all materialise) staying comfortably small.
+        # 3. Large-Tq shapes on TPU -> "pallas" (Q-tiled): verified correct
+        #    on-chip and ~4x the blockwise fwd throughput / ~2.3x fwd+bwd
+        #    (bf16 operands on the MXU fast path, f32 accumulation).
+        #    TREE_ATTN_AUTO_PALLAS=0 opts out of both TPU kernels.
+        # 4. Everything else -> "blockwise" (pure XLA, any backend).
         Tq, Tk = q.shape[2], k.shape[2]
         transient_bytes = 3 * q.shape[0] * q.shape[1] * Tq * Tk * 4
-        if Tq <= 8 and transient_bytes <= 128 * 1024 * 1024:
-            impl = "naive"
-        elif (
-            Tq >= 128
-            and os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
+        pallas_ok = (
+            os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
             and _on_tpu(q)
             and _pallas_available()
-        ):
+        )
+        # custom_vjp=False is the documented forward-mode-AD escape hatch;
+        # raw Pallas forwards have no autodiff rules, so that request keeps
+        # the jnp impls whenever one is viable at the shape.
+        naive_ok = Tq <= 8 and transient_bytes <= 128 * 1024 * 1024
+        if Tq < 128 and pallas_ok and (custom_vjp or not naive_ok):
+            impl = "pallas_decode"
+        elif naive_ok:
+            impl = "naive"
+        elif Tq >= 128 and pallas_ok:
             impl = "pallas"
         else:
             impl = "blockwise"
+    if block_size is None:
+        block_size = 2048 if impl == "pallas_decode" else 512
     if impl == "naive":
         # Raw autodiff path: the differential oracle the custom VJP is
         # tested against.
         return attention_naive(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset, kv_offset=kv_offset
         )
-    if impl == "pallas":
+    if impl in ("pallas", "pallas_decode"):
         try:
             import tree_attention_tpu.ops.pallas_attention  # noqa: F401
         except ImportError as e:
             raise NotImplementedError(
-                "impl='pallas' requested but the Pallas kernel module is not "
+                f"impl={impl!r} requested but the Pallas kernel module is not "
                 "available in this build; use impl='blockwise' or 'auto'"
             ) from e
     if not custom_vjp:
@@ -173,8 +186,17 @@ def flash_attention(
                 q, k, v, causal=causal, scale=scale, q_offset=q_offset,
                 kv_offset=kv_offset, block_size=block_size,
             )
-        # Raw Pallas forward: fine for inference; has no autodiff rules at
-        # all, so this is never silently worse than the custom VJP.
+        # Raw Pallas forwards: fine for inference; they have no autodiff
+        # rules at all, so this is never silently worse than the custom VJP.
+        if impl == "pallas_decode":
+            from tree_attention_tpu.ops.pallas_decode import (
+                attention_pallas_decode,
+            )
+
+            return attention_pallas_decode(
+                q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                kv_offset=kv_offset, block_size=block_size,
+            )
         from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
 
         return attention_pallas_fwd(

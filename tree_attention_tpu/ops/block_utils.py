@@ -19,6 +19,28 @@ NEG_INF = float("-inf")
 LANES = 128
 
 
+def matmul_precision(*dtypes):
+    """Contraction precision for the ops-layer matmuls, by operand dtype.
+
+    bf16 operands need nothing: the MXU multiplies them exactly and
+    ``preferred_element_type=f32`` accumulates in f32 — that is already the
+    best bf16 inputs can get, and requesting HIGHEST instead makes XLA upcast
+    to a multi-pass f32 contraction (~4x slower) and Mosaic reject the matmul
+    outright ("Bad lhs type").
+
+    Anything else (f32/f16/f64) must pin HIGHEST: the default matmul
+    precision may silently lower the contraction to a single bf16 pass
+    (observed ~5e-3 relative logit error on both the TPU MXU and, for some
+    contraction layouts, the CPU backend) — unacceptable in an
+    exact-attention library whose merge currency is an f32 lse.
+    """
+    from jax import lax
+
+    if all(jnp.dtype(d) == jnp.bfloat16 for d in dtypes):
+        return None
+    return lax.Precision.HIGHEST
+
+
 def pad_to_block(x: jax.Array, dim: int, block: int) -> jax.Array:
     """Zero-pad ``dim`` up to a multiple of ``block``."""
     pad = (-x.shape[dim]) % block

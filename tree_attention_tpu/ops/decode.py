@@ -44,7 +44,7 @@ def flash_decode(
     q_position=None,
     scale: Optional[float] = None,
     num_splits: Optional[int] = None,
-    block_size: int = 512,
+    block_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Causal decode attention of a few new queries against a long KV buffer.
 
@@ -58,8 +58,13 @@ def flash_decode(
         ``Tk - Tq`` (queries are the newest tokens of a fully-valid buffer).
         May be a traced scalar — decode steps jit once and run at every
         sequence length.
-      num_splits: KV chunks computed in parallel; default scales with
-        ``Tk / block_size`` (capped at 16).
+      num_splits: KV chunks computed in parallel on the chunked-vmap (CPU)
+        path; default scales with ``Tk / block_size`` (capped at 16). The
+        TPU Pallas kernel is split-KV internally (one chunk per ``block_size``
+        KV tile), so this knob is inert there.
+      block_size: KV tile length. ``None`` picks the impl-appropriate
+        default (2048 for the TPU kernel, 512 for the chunked path); an
+        explicit value is honored as given on both paths.
 
     Returns:
       ``(out, lse)``: ``(B, Hq, Tq, D)`` in q's dtype, ``(B, Hq, Tq)`` float32.
@@ -68,6 +73,28 @@ def flash_decode(
     Tk = k.shape[2]
     if q_position is None:
         q_position = Tk - Tq
+
+    # On TPU the Pallas flash-decode kernel subsumes the chunked-vmap form:
+    # it is itself split-KV (sequential KV tiles with carried online-softmax
+    # state) and streams at the HBM roofline at any context length.
+    import os
+
+    from tree_attention_tpu.ops import _on_tpu, _pallas_available
+
+    if (
+        os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
+        and _on_tpu(q)
+        and _pallas_available()
+    ):
+        from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+        return attention_pallas_decode(
+            q, k, v, causal=True, scale=scale,
+            q_offset=q_position, kv_offset=0,
+            block_size=2048 if block_size is None else block_size,
+        )
+
+    block_size = 512 if block_size is None else block_size
     S = num_splits if num_splits is not None else default_num_splits(Tk, block_size)
     S = max(1, min(S, Tk))
     chunk = -(-Tk // S)  # ceil

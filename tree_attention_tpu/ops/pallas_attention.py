@@ -38,7 +38,11 @@ from tree_attention_tpu.ops.block_utils import (
     tile_live,
 )
 
-from tree_attention_tpu.ops.block_utils import LANES as _LANES, NEG_INF
+from tree_attention_tpu.ops.block_utils import (
+    LANES as _LANES,
+    NEG_INF,
+    matmul_precision,
+)
 
 
 def _flash_fwd_kernel(
@@ -86,6 +90,7 @@ def _flash_fwd_kernel(
             k_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=matmul_precision(q_ref.dtype, k_ref.dtype),
         ) * scale  # (bq, bk) f32
 
         valid = col_idx < tk  # mask host-side padding of ragged Tk
@@ -108,6 +113,7 @@ def _flash_fwd_kernel(
             p.astype(v_ref.dtype), v_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=matmul_precision(v_ref.dtype, v_ref.dtype),
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)

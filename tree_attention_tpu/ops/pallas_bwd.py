@@ -36,7 +36,11 @@ from tree_attention_tpu.ops.block_utils import (
     tile_live,
 )
 
-from tree_attention_tpu.ops.block_utils import LANES as _LANES, NEG_INF
+from tree_attention_tpu.ops.block_utils import (
+    LANES as _LANES,
+    NEG_INF,
+    matmul_precision,
+)
 
 
 def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
@@ -49,6 +53,7 @@ def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
     s = lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=matmul_precision(q.dtype, k.dtype),
     ) * scale
     valid = col_idx < tk
     if causal:
@@ -60,6 +65,7 @@ def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
     dp = lax.dot_general(
         dout, v, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=matmul_precision(dout.dtype, v.dtype),
     )
     ds = p * (dp - delta)
     return p, ds
@@ -92,6 +98,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k_ref.dtype), k_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=matmul_precision(k_ref.dtype, k_ref.dtype),
         ) * scale
 
     @pl.when(ki == n_k - 1)
@@ -130,11 +137,13 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q_ref.dtype), q_ref[0],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=matmul_precision(q_ref.dtype, q_ref.dtype),
         ) * scale
         dv_scr[...] += lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=matmul_precision(do_ref.dtype, do_ref.dtype),
         )
 
     @pl.when(gq == n_gq - 1)

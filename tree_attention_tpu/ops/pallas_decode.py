@@ -1,0 +1,254 @@
+"""Pallas TPU split-KV flash-decode kernel for small-Tq (inference) shapes.
+
+Decode is the reference's entire workload (``/root/reference/model.py:140-145``:
+one query token against a 64k-token KV buffer). It is bandwidth-bound — the
+chip must stream every KV byte once — so the kernel's only job is to keep the
+per-KV-row compute cost below the HBM delivery rate.
+
+Layout (chosen by measurement on v5e; see the design notes below):
+
+- **Q-major scores.** The score tile is ``(r8, block_k)``: packed query rows
+  on sublanes (padded to a multiple of 8), KV positions across lanes. The
+  QKᵀ matmul is then ``(r8, D) x (D, block_k)`` — ``r8·D·block_k`` MACs, a
+  factor ``128/r8`` cheaper than a KV-major layout that pads queries to the
+  128-lane width. A KV-major variant measured MXU-bound at ~25% of the HBM
+  roofline for MHA decode precisely because of that padding; this layout's
+  matmul cost is ~``block_k/16`` MXU cycles per tile against a DMA cost of
+  ~``0.6·block_k`` cycles — comfortably DMA-bound.
+- **The GQA group rides in the Q tile.** Queries are packed per KV head as
+  ``(group × Tq)`` rows, and the grid runs over ``B·Hkv``, so each KV head's
+  stream is read exactly **once** regardless of group size. (The Q-tiled
+  training kernel instead re-reads KV per query head: measured 12% of
+  roofline on GQA-8 decode, 8× the necessary bytes.)
+- **Split-KV as the sequential grid dimension.** KV tiles iterate in the
+  last grid dimension with the running online-softmax state ``(m, l, acc)``
+  in VMEM scratch — the in-kernel mirror of
+  :func:`tree_attention_tpu.ops.reference.merge_partials`, so the emitted
+  ``(out, lse)`` plugs into the cross-device tree merge unchanged.
+- Causal masking uses global offsets from SMEM (they are traced values
+  inside jitted decode steps); tiles whose every KV position is masked skip
+  both matmuls via ``pl.when``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tree_attention_tpu.ops.block_utils import (
+    LANES as _LANES,
+    NEG_INF,
+    matmul_precision,
+    pad_to_block as _pad_dim,
+)
+
+
+def _flash_decode_kernel(
+    offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
+    q_ref,     # VMEM (1, bq, D) — packed (group × Tq) queries of one KV head
+    k_ref,     # VMEM (1, bk, D)
+    v_ref,     # VMEM (1, bk, D)
+    out_ref,   # VMEM (1, bq, D)
+    lse_ref,   # VMEM (1, bq, LANES) — lse broadcast across lanes (host
+               # slices lane 0; TPU tiling wants a 128-multiple trailing dim)
+    m_scr,     # VMEM (bq, LANES) f32 — running max
+    l_scr,     # VMEM (bq, LANES) f32 — running sum
+    acc_scr,   # VMEM (bq, D) f32
+    *,
+    scale: float,
+    causal: bool,
+    tk: int,
+    tq: int,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    si = pl.program_id(2)
+    n_s = pl.num_programs(2)
+
+    q_offset = offs_ref[0, 0]
+    kv_offset = offs_ref[1, 0]
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    bq, bk = block_q, block_k
+
+    # Tile liveness: skip both matmuls when every KV position of this tile is
+    # invisible — beyond Tk (host padding), or, under causality, past the
+    # most visible query row of this Q tile. Packed row j is query index
+    # (j % Tq), so the tile's maximum query position is q_offset + Tq - 1.
+    live = si * bk < tk
+    if causal:
+        live &= (kv_offset + si * bk) <= (q_offset + tq - 1)
+
+    @pl.when(live)
+    def _compute():
+        # Scores (bq, bk): packed queries on sublanes, KV across lanes.
+        # Operands stay in their native dtype (bf16 MXU fast path) with f32
+        # accumulation; see matmul_precision for the precision contract.
+        s = lax.dot_general(
+            q_ref[0],
+            k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(q_ref.dtype, k_ref.dtype),
+        ) * scale  # (bq, bk) f32
+
+        # Visibility: lane i is KV global position kv_offset + si*bk + i;
+        # sublane j is query row ((qi*bq + j) % Tq) at global position
+        # q_offset + that. Padded rows (j >= r) alias a real query's position
+        # and compute a duplicate row the host slices away.
+        col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = col_idx < tk
+        if causal:
+            q_pos = q_offset + (
+                (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)) % tq
+            )
+            valid &= (kv_offset + col_idx) <= q_pos
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+        p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        # P·V with the FA2 p-downcast (probabilities are in [0,1], bf16
+        # relative error stays small), f32 accumulation. When Tk is ragged
+        # the last tile's trailing V rows are unspecified garbage (Pallas
+        # loads the partial block unpadded; interpret mode NaN-poisons it) —
+        # p's masked columns are exactly 0, but 0·NaN = NaN, so those rows
+        # must be zeroed. Static no-op for divisible shapes.
+        v_tile = v_ref[0]
+        if tk % bk:
+            row_ok = (
+                si * bk + lax.broadcasted_iota(jnp.int32, v_tile.shape, 0)
+            ) < tk
+            v_tile = jnp.where(row_ok, v_tile, 0)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p.astype(v_ref.dtype), v_tile,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(v_ref.dtype, v_ref.dtype),
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == n_s - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        empty = l <= 0.0
+        l_safe = jnp.where(empty, 1.0, l)
+        out_ref[0] = (
+            jnp.where(empty, 0.0, acc_scr[...] / l_safe)
+        ).astype(out_ref.dtype)
+        lse = jnp.where(
+            empty, NEG_INF, jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)
+        )
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_size", "interpret"),
+)
+def attention_pallas_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_size: int = 2048,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Split-KV flash decode. Same ``(out, lse)`` contract as the other impls.
+
+    Intended for Tq < 128 (the decode/speculative regime); any Tq works but
+    the Q-tiled training kernel
+    (:func:`tree_attention_tpu.ops.pallas_attention.attention_pallas_fwd`)
+    is the right shape for large Tq. ``interpret=None`` auto-selects:
+    compiled on TPU, interpreter elsewhere (what CI exercises on CPU).
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    s = (D ** -0.5) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    if Tk == 0:
+        return jnp.zeros_like(q), jnp.full((B, Hq, Tq), NEG_INF, jnp.float32)
+
+    # Pack each KV head's queries (its whole GQA group × Tq rows) into the
+    # Q-tile sublanes: (B, Hq, Tq, D) -> (B·Hkv, r8, D).
+    r = G * Tq
+    bq = min(-(-r // 8) * 8, 128)
+    qp = _pad_dim(q.reshape(B, Hkv, r, D), 2, bq).reshape(B * Hkv, -1, D)
+    n_q = qp.shape[1] // bq
+
+    # No host-side KV padding: Pallas handles a ragged last block itself and
+    # the kernel's ``col_idx < tk`` mask drops the garbage columns. An
+    # explicit jnp.pad here would copy the ENTIRE KV buffer every decode step
+    # whenever Tk % bk != 0 — measured as the difference between 27% and 92%
+    # of the HBM roofline on the reference's 64000-token workload.
+    bk = min(block_size, max(Tk, _LANES))
+    kp = k.reshape(B * Hkv, Tk, D)
+    vp = v.reshape(B * Hkv, Tk, D)
+    n_s = -(-Tk // bk)
+
+    offs = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    ).reshape(2, 1)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash_decode_kernel,
+            scale=s, causal=causal, tk=Tk, tq=Tq, block_q=bq, block_k=bk,
+        ),
+        grid=(B * Hkv, n_q, n_s),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * Hkv, n_q * bq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, n_q * bq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qp, kp, vp)
+
+    out = out[:, :r].reshape(B, Hq, Tq, D)
+    lse = lse[:, :r, 0].reshape(B, Hq, Tq)
+    return out, lse

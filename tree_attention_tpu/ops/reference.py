@@ -41,7 +41,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tree_attention_tpu.ops.block_utils import NEG_INF  # noqa: F401  (canonical home)
+from tree_attention_tpu.ops.block_utils import (  # noqa: F401  (canonical home)
+    NEG_INF,
+    matmul_precision,
+)
 
 
 def _default_scale(head_dim: int, scale: Optional[float]) -> float:
@@ -112,8 +115,13 @@ def attention_naive(
         )
 
     qg = q.reshape(B, Hkv, G, Tq, D)
+    # See matmul_precision: non-bf16 operands must not be silently lowered
+    # to a single bf16 pass (MXU on TPU, and observed on the CPU backend for
+    # some contraction layouts) — unacceptable in the oracle; bf16 operands
+    # already multiply exactly into f32 and keep the MXU fast path.
     logits = jnp.einsum(
-        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
+        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32,
+        precision=matmul_precision(qg.dtype, k.dtype),
     ) * s
     if causal:
         mask = _causal_mask(Tq, Tk, q_offset, kv_offset)
@@ -125,7 +133,13 @@ def attention_naive(
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
     p = jnp.exp(logits - m_safe[..., None])
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    # The value contraction runs in full f32 (p carries real f32 precision
+    # from the exp) — this is the oracle; perf paths do the FA2 p-downcast
+    # trick instead.
+    acc = jnp.einsum(
+        "bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32),
+        precision=matmul_precision(jnp.float32),
+    )
     return finalize(
         acc.reshape(B, Hq, Tq, D),
         m.reshape(B, Hq, Tq),
@@ -188,6 +202,7 @@ def attention_blockwise(
         logits = jnp.einsum(
             "bhgqd,bhkd->bhgqk", qf, k_blk.astype(jnp.float32),
             preferred_element_type=jnp.float32,
+            precision=matmul_precision(jnp.float32),
         )
         valid = tile_mask(Tq, blk, blk_idx, Tk, q_offset, kv_offset, causal)
         logits = jnp.where(valid[None, None, None], logits, NEG_INF)
@@ -199,7 +214,8 @@ def attention_blockwise(
         p = jnp.exp(logits - m_safe[..., None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhgqk,bhkd->bhgqd", p, v_blk.astype(jnp.float32)
+            "bhgqk,bhkd->bhgqd", p, v_blk.astype(jnp.float32),
+            precision=matmul_precision(jnp.float32),
         )
         return (m_new, l_new, acc_new), None
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tree_attention_tpu.ops.block_utils import matmul_precision
 from tree_attention_tpu.ops.reference import (
     NEG_INF,
     attention_blockwise,
@@ -72,6 +73,15 @@ def _raw_forward(cfg, q, k, v, q_offset, kv_offset):
         from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
 
         return attention_pallas_fwd(
+            q, k, v, causal=cfg.causal, scale=cfg.scale,
+            q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
+        )
+    if cfg.impl == "pallas_decode":
+        # Decode-shaped forward; its backward runs the blockwise jnp
+        # recomputation (decode grads are rare and Tq is tiny there).
+        from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+        return attention_pallas_decode(
             q, k, v, causal=cfg.causal, scale=cfg.scale,
             q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
         )
@@ -172,18 +182,23 @@ def attention_bwd_blockwise(
         kf = k_blk.astype(jnp.float32)
         vf = v_blk.astype(jnp.float32)
         logits = jnp.einsum(
-            "bhgqd,bhkd->bhgqk", qf, kf, preferred_element_type=jnp.float32
+            "bhgqd,bhkd->bhgqk", qf, kf, preferred_element_type=jnp.float32,
+            precision=matmul_precision(jnp.float32),
         ) * s
         valid = tile_mask(Tq, blk, blk_idx, Tk, q_offset, kv_offset, causal)
         logits = jnp.where(valid[None, None, None], logits, NEG_INF)
 
         p = jnp.exp(logits - lse_safe[..., None])  # (B,Hkv,G,Tq,blk)
-        dp = jnp.einsum("bhgqd,bhkd->bhgqk", doutf, vf)
+        dp = jnp.einsum("bhgqd,bhkd->bhgqk", doutf, vf,
+                        precision=matmul_precision(jnp.float32))
         ds = p * (dp - delta[..., None])  # lse cotangent already folded in
 
-        dq_blk = jnp.einsum("bhgqk,bhkd->bhgqd", ds, kf) * s
-        dk_blk = jnp.einsum("bhgqk,bhgqd->bhkd", ds, qf) * s
-        dv_blk = jnp.einsum("bhgqk,bhgqd->bhkd", p, doutf)
+        dq_blk = jnp.einsum("bhgqk,bhkd->bhgqd", ds, kf,
+                            precision=matmul_precision(jnp.float32)) * s
+        dk_blk = jnp.einsum("bhgqk,bhgqd->bhkd", ds, qf,
+                            precision=matmul_precision(jnp.float32)) * s
+        dv_blk = jnp.einsum("bhgqk,bhgqd->bhkd", p, doutf,
+                            precision=matmul_precision(jnp.float32))
         return dq_acc + dq_blk, (dk_blk, dv_blk)
 
     idxs = jnp.arange(num_blocks)

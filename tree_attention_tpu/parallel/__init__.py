@@ -12,4 +12,10 @@ from tree_attention_tpu.parallel.mesh import (  # noqa: F401
     shard_along,
 )
 from tree_attention_tpu.parallel.ring import ring_attention  # noqa: F401
-from tree_attention_tpu.parallel.tree import tree_attention, tree_decode  # noqa: F401
+from tree_attention_tpu.parallel.tree import (  # noqa: F401
+    shard_zigzag,
+    tree_attention,
+    tree_decode,
+    unshard_zigzag,
+    zigzag_perm,
+)

@@ -80,7 +80,7 @@ def ring_attention(
     scale: Optional[float] = None,
     q_position: Optional[int] = None,
     impl: str = "auto",
-    block_size: int = 512,
+    block_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fully sequence-sharded exact attention via KV ring rotation.
 

@@ -40,8 +40,55 @@ from jax.sharding import Mesh, PartitionSpec as P
 shard_map = jax.shard_map
 
 from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
-from tree_attention_tpu.ops.reference import NEG_INF
+from tree_attention_tpu.ops.reference import NEG_INF, merge_partials
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
+
+
+def zigzag_perm(t: int, n_shards: int):
+    """Natural→zigzag sequence permutation for causally balanced sharding.
+
+    Under causal masking a contiguously sharded sequence is pathologically
+    imbalanced: the device holding the first KV block has ~every query tile
+    live while the device holding the last has ~1/N — wall clock is ~2× the
+    balanced ideal (SURVEY.md §7 hard part 2). The zigzag layout gives shard
+    ``j`` the two half-blocks ``j`` and ``2N-1-j``, so each shard's live work
+    is ``2T - (2N-1)·half`` tiles — constant in ``j``.
+
+    Returns ``(perm, inv)`` numpy index vectors: ``zigzag = natural[perm]``
+    and ``natural = zigzag[inv]``. Requires ``t % (2·n_shards) == 0``.
+    """
+    import numpy as np
+
+    if t % (2 * n_shards):
+        raise ValueError(
+            f"sequence length {t} must divide into 2×{n_shards} half-blocks"
+        )
+    half = t // (2 * n_shards)
+    blocks = []
+    for j in range(n_shards):
+        blocks.append(np.arange(j * half, (j + 1) * half))
+        blocks.append(np.arange((2 * n_shards - 1 - j) * half,
+                                (2 * n_shards - j) * half))
+    perm = np.concatenate(blocks)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(t)
+    return perm, inv
+
+
+def shard_zigzag(x: jax.Array, axis: int, n_shards: int) -> jax.Array:
+    """Reorder ``axis`` from natural to zigzag order (host-side layout step).
+
+    After this, sharding ``axis`` contiguously over the mesh's seq axis gives
+    each device its two causally-balanced half-blocks.
+    """
+    perm, _ = zigzag_perm(x.shape[axis], n_shards)
+    return jnp.take(x, jnp.asarray(perm), axis=axis)
+
+
+def unshard_zigzag(x: jax.Array, axis: int, n_shards: int) -> jax.Array:
+    """Inverse of :func:`shard_zigzag`: zigzag order back to natural order."""
+    _, inv = zigzag_perm(x.shape[axis], n_shards)
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
 
 
 def _merge_across(
@@ -101,7 +148,7 @@ def tree_decode(
     scale: Optional[float] = None,
     q_position: Optional[int] = None,
     impl: str = "auto",
-    block_size: int = 512,
+    block_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Replicated-Q, sequence-sharded-KV exact attention (the decode shape).
 
@@ -166,7 +213,8 @@ def tree_attention(
     scale: Optional[float] = None,
     q_position: Optional[int] = None,
     impl: str = "auto",
-    block_size: int = 512,
+    block_size: Optional[int] = None,
+    layout: str = "contiguous",
 ) -> Tuple[jax.Array, jax.Array]:
     """Fully sequence-sharded exact attention (the training shape).
 
@@ -178,9 +226,22 @@ def tree_attention(
     of ``all_gather`` is ``psum_scatter`` and vice versa, so gradient
     collectives mirror the forward automatically.
 
+    ``layout`` selects how the sequence dim maps to shards:
+
+    - ``"contiguous"`` — shard ``j`` holds rows ``[j·T/N, (j+1)·T/N)``.
+      Simple, but causally imbalanced (~2× the balanced wall clock).
+    - ``"zigzag"`` — the arrays are expected pre-permuted with
+      :func:`shard_zigzag`, so shard ``j`` holds half-blocks ``j`` and
+      ``2N-1-j`` and live causal work is equal across shards. Outputs come
+      back in the same zigzag order (undo with :func:`unshard_zigzag`).
+      Costs one local static permutation of the gathered Q and one of the
+      packed merge payload — O(T·D) copies against O(T²/N) attention work.
+
     Returns:
       ``(out, lse)`` sharded like ``q``.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"layout must be 'contiguous' or 'zigzag', got {layout!r}")
     B, Hq, Tq_global, D = q.shape
     if q_position is None:
         # Bottom-right causal alignment, same convention as tree_decode: the
@@ -197,6 +258,16 @@ def tree_attention(
     Tk_local = k.shape[2] // n_shards
     impl = resolve_impl_for_mesh(impl, mesh)
 
+    if layout == "zigzag":
+        q_perm, q_inv = zigzag_perm(Tq_global, n_shards)
+        q_perm = jnp.asarray(q_perm)
+        q_inv = jnp.asarray(q_inv)
+        half_k = Tk_local // 2
+        if Tk_local % 2:
+            raise ValueError(
+                f"zigzag needs an even local KV length, got {Tk_local}"
+            )
+
     spec = P(data_axis, head_axis, seq_axis, None)
     lse_spec = P(data_axis, head_axis, seq_axis)
 
@@ -210,14 +281,44 @@ def tree_attention(
     def _sharded(q_l, k_l, v_l):
         shard = lax.axis_index(seq_axis)
         q_glob = lax.all_gather(q_l, seq_axis, axis=2, tiled=True)
-        out, lse = flash_attention(
-            q_glob, k_l, v_l,
-            causal=causal, scale=scale,
-            q_offset=q_position,
-            kv_offset=shard * Tk_local,
-            impl=impl, block_size=block_size,
-        )
+        if layout == "contiguous":
+            out, lse = flash_attention(
+                q_glob, k_l, v_l,
+                causal=causal, scale=scale,
+                q_offset=q_position,
+                kv_offset=shard * Tk_local,
+                impl=impl, block_size=block_size,
+            )
+        else:
+            # The gather returns zigzag order; un-permute once so the flash
+            # kernels see natural global Q positions and plain offsets.
+            q_glob = jnp.take(q_glob, q_inv, axis=2)
+            halves = (
+                (k_l[:, :, :half_k], v_l[:, :, :half_k], shard * half_k),
+                (
+                    k_l[:, :, half_k:],
+                    v_l[:, :, half_k:],
+                    (2 * n_shards - 1 - shard) * half_k,
+                ),
+            )
+            outs, lses = [], []
+            for k_h, v_h, kv_off in halves:
+                o, l = flash_attention(
+                    q_glob, k_h, v_h,
+                    causal=causal, scale=scale,
+                    q_offset=q_position,
+                    kv_offset=kv_off,
+                    impl=impl, block_size=block_size,
+                )
+                outs.append(o)
+                lses.append(l)
+            out, lse = merge_partials(jnp.stack(outs), jnp.stack(lses))
         packed, m = _weigh_and_pack(out, lse, seq_axis)
+        if layout == "zigzag":
+            # Back to zigzag row order so the scatter lands each shard's own
+            # (zigzag) rows.
+            packed = jnp.take(packed, q_perm, axis=2)
+            m = jnp.take(m, q_perm, axis=2)
         packed = lax.psum_scatter(packed, seq_axis, scatter_dimension=2, tiled=True)
         num, den = packed[..., :D], packed[..., D]
         m_local = lax.dynamic_slice_in_dim(m, shard * Tq_local, Tq_local, axis=2)

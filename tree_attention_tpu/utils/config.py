@@ -53,8 +53,8 @@ class RunConfig:
     mesh: Optional[str] = None  # e.g. "seq=8" or "data=2,seq=2,model=2"
     n_virtual_cpu: int = 0  # >0: force N virtual CPU devices (tests/emulation)
     launch: int = 0  # >1: respawn N coordinated processes (multi-host shape)
-    impl: str = "auto"  # auto | naive | blockwise | pallas
-    block_size: int = 512
+    impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
+    block_size: Optional[int] = None  # None -> impl-appropriate default
     seed: int = 0
 
     # Timing / bench.
@@ -128,9 +128,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--causal", action="store_true", default=d.causal)
     p.add_argument("--dtype", choices=["bfloat16", "float16", "float32"],
                    default=d.dtype)
-    p.add_argument("--impl", choices=["auto", "naive", "blockwise", "pallas"],
+    p.add_argument("--impl",
+                   choices=["auto", "naive", "blockwise", "pallas",
+                            "pallas_decode"],
                    default=d.impl)
-    p.add_argument("--block-size", type=int, default=d.block_size)
+    p.add_argument("--block-size", type=int, default=d.block_size,
+                   help="KV tile length (default: per-impl tuned value)")
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--iters", type=int, default=d.iters)
     p.add_argument("--warmup", type=int, default=d.warmup)
