@@ -145,3 +145,59 @@ def test_zigzag_live_work_balance(n_shards):
     assert max(contiguous) / min(contiguous) > 2.0
     # Zigzag: within 15% (VERDICT round-1 acceptance bar); actually exact.
     assert max(zigzag) / min(zigzag) <= 1.15
+
+
+def test_transformer_zigzag_loss_equals_contiguous():
+    """End-to-end LM train loss is layout-invariant: same tokens, same
+    positions (via RoPE), permutation-invariant mean."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tree_attention_tpu.models import TransformerConfig, init_params
+    from tree_attention_tpu.models.transformer import loss_fn
+
+    mesh = cpu_mesh(4)
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_head=8, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        attn_impl="blockwise", attn_block_size=8,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    key = jax.random.PRNGKey(1)
+    batch = {
+        "inputs": jax.random.randint(key, (2, 64), 0, 64),
+        "targets": jax.random.randint(jax.random.fold_in(key, 1), (2, 64), 0, 64),
+    }
+    loss_c = loss_fn(params, batch, cfg, mesh=mesh)
+    cfg_z = dataclasses.replace(cfg, seq_layout="zigzag")
+    loss_z = loss_fn(params, batch, cfg_z, mesh=mesh)
+    np.testing.assert_allclose(float(loss_z), float(loss_c), atol=1e-5, rtol=1e-5)
+
+
+def test_transformer_zigzag_train_step_runs():
+    """Full train step (fwd+bwd+optimizer) over data x seq mesh in zigzag."""
+    import jax.numpy as jnp
+
+    from tree_attention_tpu.models import (
+        TransformerConfig, default_optimizer, init_train_state,
+        make_train_step, shard_batch,
+    )
+    from tree_attention_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+
+    mesh = cpu_mesh(8, {AXIS_DATA: 2, AXIS_SEQ: 4})
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_head=8, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        attn_impl="blockwise", attn_block_size=8, seq_layout="zigzag",
+    )
+    opt = default_optimizer()
+    state = init_train_state(jax.random.PRNGKey(0), cfg, opt, mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh)
+    key = jax.random.PRNGKey(1)
+    batch = shard_batch(mesh, {
+        "inputs": jax.random.randint(key, (2, 64), 0, 64),
+        "targets": jax.random.randint(jax.random.fold_in(key, 1), (2, 64), 0, 64),
+    })
+    state, loss = step(state, batch)
+    assert float(loss) > 0 and float(loss) == float(loss)

@@ -181,6 +181,7 @@ def _transformer_config(cfg: RunConfig):
         dtype=_dtype(cfg),
         attn_impl=cfg.impl,
         attn_block_size=cfg.block_size,
+        seq_layout=cfg.seq_layout,
     )
 
 

@@ -55,6 +55,11 @@ class TransformerConfig:
     d_ff: int = 1408             # ~8/3 · d_model, rounded to a lane multiple
     max_seq_len: int = 65536
     rope_theta: float = 10000.0
+    # "zigzag" permutes the sequence so causal work balances across the
+    # mesh's seq shards (parallel.tree.zigzag_perm); positions ride RoPE so
+    # the model is exactly equivalent to contiguous order. Ignored without a
+    # >1-way seq axis.
+    seq_layout: str = "contiguous"
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16    # activation/param compute dtype
     attn_impl: str = "auto"      # flash_attention impl selector
@@ -217,6 +222,7 @@ def _attention_block(
     cfg: TransformerConfig,
     mesh: Optional[Mesh],
     axes: Dict[str, Optional[str]],
+    layout: str = "contiguous",
 ) -> jax.Array:
     q = _heads(x @ p["wq"], cfg.n_heads, cfg.d_head)
     k = _heads(x @ p["wk"], cfg.n_kv_heads, cfg.d_head)
@@ -236,6 +242,7 @@ def _attention_block(
             causal=True,
             impl=cfg.attn_impl,
             block_size=cfg.attn_block_size,
+            layout=layout,
         )
     else:
         out, _ = flash_attention(
@@ -249,6 +256,18 @@ def _attention_block(
 
 def _mlp_block(p: Params, x: jax.Array) -> jax.Array:
     return (jax.nn.silu(x @ p["w1"]) * (x @ p["w3"])) @ p["w2"]
+
+
+def _resolved_layout(cfg, mesh, axes) -> str:
+    """zigzag only matters (and only type-checks) on a >1-way seq axis."""
+    if (
+        cfg.seq_layout == "zigzag"
+        and mesh is not None
+        and axes.get("seq")
+        and mesh.shape.get(axes["seq"], 1) > 1
+    ):
+        return "zigzag"
+    return "contiguous"
 
 
 # ---------------------------------------------------------------------------
@@ -288,14 +307,27 @@ def forward(
     T = tokens.shape[1]
     if T > cfg.max_seq_len:
         raise ValueError(f"sequence length {T} exceeds max_seq_len={cfg.max_seq_len}")
-    positions = jnp.arange(T, dtype=jnp.int32)
+    layout = _resolved_layout(cfg, mesh, axes)
+    if layout == "zigzag":
+        # Permute the (tiny, int32) token array once; every later op is
+        # position-pointwise, RoPE reads the true global positions, and the
+        # zigzag tree_attention handles cross-shard causality. Model output
+        # is row-for-row the contiguous model's output, permuted.
+        from tree_attention_tpu.parallel.tree import zigzag_perm
+
+        perm, _ = zigzag_perm(T, mesh.shape[axes["seq"]])
+        perm = jnp.asarray(perm)
+        tokens = jnp.take(tokens, perm, axis=1)
+        positions = perm.astype(jnp.int32)
+    else:
+        positions = jnp.arange(T, dtype=jnp.int32)
     x = constrain(jnp.take(params["embed"], tokens, axis=0))
 
     def body(x, layer):
         x = x + constrain(
             _attention_block(
                 layer, rms_norm(x, layer["ln1"], cfg.norm_eps),
-                positions, cfg, mesh, axes,
+                positions, cfg, mesh, axes, layout,
             )
         )
         x = x + constrain(_mlp_block(layer, rms_norm(x, layer["ln2"], cfg.norm_eps)))
@@ -335,4 +367,23 @@ def loss_fn(
     inside the model would break the mesh divisibility contract).
     """
     logits = forward(params, batch["inputs"], cfg, **fwd_kw)
-    return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+    targets, mask = batch["targets"], batch.get("mask")
+    mesh = fwd_kw.get("mesh")
+    axes = {
+        "seq": fwd_kw.get("seq_axis", AXIS_SEQ),
+        "data": fwd_kw.get("data_axis", AXIS_DATA),
+        "model": fwd_kw.get("model_axis", AXIS_MODEL),
+    }
+    from tree_attention_tpu.parallel.mesh import prune_axes
+
+    if _resolved_layout(cfg, mesh, prune_axes(mesh, axes)) == "zigzag":
+        # Logits come back in zigzag row order; align the labels. The mean
+        # is permutation-invariant, so the loss equals the contiguous one.
+        from tree_attention_tpu.parallel.tree import zigzag_perm
+
+        perm, _ = zigzag_perm(targets.shape[1], mesh.shape[axes["seq"]])
+        perm = jnp.asarray(perm)
+        targets = jnp.take(targets, perm, axis=1)
+        if mask is not None:
+            mask = jnp.take(mask, perm, axis=1)
+    return cross_entropy_loss(logits, targets, mask)

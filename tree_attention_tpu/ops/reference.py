@@ -196,7 +196,7 @@ def attention_blockwise(
     l0 = jnp.zeros((B, Hkv, G, Tq), jnp.float32)
     acc0 = jnp.zeros((B, Hkv, G, Tq, D), jnp.float32)
 
-    def body(carry, inputs):
+    def compute(carry, inputs):
         m_prev, l_prev, acc = carry
         blk_idx, k_blk, v_blk = inputs
         logits = jnp.einsum(
@@ -217,7 +217,19 @@ def attention_blockwise(
             "bhgqk,bhkd->bhgqd", p, v_blk.astype(jnp.float32),
             precision=matmul_precision(jnp.float32),
         )
-        return (m_new, l_new, acc_new), None
+        return m_new, l_new, acc_new
+
+    def body(carry, inputs):
+        if not causal:
+            return compute(carry, inputs), None
+        # Skip fully-masked blocks: a block is live iff its most visible
+        # pairing (last query row, first key column) is unmasked. This makes
+        # causal work proportional to live tiles — the property the zigzag
+        # layout balances across shards (the Pallas kernels skip via
+        # pl.when; this is the same cull for the jnp fallback).
+        blk_idx = inputs[0]
+        live = (q_offset + Tq - 1) >= (kv_offset + blk_idx * blk)
+        return lax.cond(live, compute, lambda c, _: c, carry, inputs), None
 
     idxs = jnp.arange(num_blocks)
     (m, l, acc), _ = lax.scan(body, (m0, l0, acc0), (idxs, kb, vb))

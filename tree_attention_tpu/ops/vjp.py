@@ -177,7 +177,7 @@ def attention_bwd_blockwise(
 
     kb, vb, num_blocks, blk = split_kv_blocks(k, v, block_size)
 
-    def body(dq_acc, inputs):
+    def compute(dq_acc, inputs):
         blk_idx, k_blk, v_blk = inputs
         kf = k_blk.astype(jnp.float32)
         vf = v_blk.astype(jnp.float32)
@@ -200,6 +200,20 @@ def attention_bwd_blockwise(
         dv_blk = jnp.einsum("bhgqk,bhgqd->bhkd", p, doutf,
                             precision=matmul_precision(jnp.float32))
         return dq_acc + dq_blk, (dk_blk, dv_blk)
+
+    def skip(dq_acc, inputs):
+        _, k_blk, v_blk = inputs
+        zero = jnp.zeros((B, Hkv, k_blk.shape[2], D), jnp.float32)
+        return dq_acc, (zero, zero)
+
+    def body(dq_acc, inputs):
+        if not causal:
+            return compute(dq_acc, inputs)
+        # Same live-tile cull as the forward: fully-masked blocks have p = 0
+        # everywhere, hence zero dk/dv and no dq contribution.
+        blk_idx = inputs[0]
+        live = (q_offset + Tq - 1) >= (kv_offset + blk_idx * blk)
+        return lax.cond(live, compute, skip, dq_acc, inputs)
 
     idxs = jnp.arange(num_blocks)
     dq0 = jnp.zeros((B, Hkv, G, Tq, D), jnp.float32)

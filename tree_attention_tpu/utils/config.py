@@ -55,6 +55,7 @@ class RunConfig:
     launch: int = 0  # >1: respawn N coordinated processes (multi-host shape)
     impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
     block_size: Optional[int] = None  # None -> impl-appropriate default
+    seq_layout: str = "contiguous"  # contiguous | zigzag (train mode, seq>1)
     seed: int = 0
 
     # Timing / bench.
@@ -134,6 +135,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    default=d.impl)
     p.add_argument("--block-size", type=int, default=d.block_size,
                    help="KV tile length (default: per-impl tuned value)")
+    p.add_argument("--seq-layout", choices=["contiguous", "zigzag"],
+                   default=d.seq_layout,
+                   help="train mode: sequence layout over the seq mesh axis "
+                        "(zigzag balances causal work across shards)")
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--iters", type=int, default=d.iters)
     p.add_argument("--warmup", type=int, default=d.warmup)
