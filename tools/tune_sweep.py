@@ -1,0 +1,130 @@
+"""Kernel tile-size sweep on the attached TPU chip.
+
+Prints one JSON line per measurement; the winners go into
+``tree_attention_tpu/ops/tuning.py``. Run from the repo root:
+
+    python tools/tune_sweep.py decode   # flash-decode kernel block_k sweep
+    python tools/tune_sweep.py fwd      # training fwd kernel (bq, bk) sweep
+    python tools/tune_sweep.py bwd      # fwd+bwd through the custom VJP
+
+Uses the slope-timing protocol (utils.profiling.time_per_step) — single-call
+timings on the tunneled transport are garbage.
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, ".")
+
+from tree_attention_tpu.utils.profiling import time_per_step  # noqa: E402
+
+HBM = 819e9
+
+
+def _qkv(H, Hkv, Tq, T, D=128):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (
+        jax.random.normal(kq, (1, H, Tq, D), jnp.bfloat16),
+        jax.random.normal(kk, (1, Hkv, T, D), jnp.bfloat16),
+        jax.random.normal(kv, (1, Hkv, T, D), jnp.bfloat16),
+    )
+
+
+def _chain(step, n):
+    def f(q, k, v):
+        def body(qc, _):
+            return step(qc, k, v).astype(qc.dtype), None
+
+        return lax.scan(body, q, None, length=n)[0]
+
+    return jax.jit(f)
+
+
+def sweep_decode():
+    from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+    for H, Hkv, T, ns, nl in (
+        (16, 16, 64000, 16, 64),
+        (32, 4, 131072, 16, 64),
+        (16, 16, 1 << 20, 2, 8),
+        (32, 4, 1 << 20, 4, 16),
+    ):
+        q, k, v = _qkv(H, Hkv, 1, T)
+        for bk in (512, 1024, 2048, 4096):
+            try:
+                step = lambda qc, k_, v_: attention_pallas_decode(
+                    qc, k_, v_, block_size=bk
+                )[0]
+                per, _, _ = time_per_step(
+                    lambda n: _chain(step, n), q, k, v,
+                    n_small=ns, n_large=nl, iters=3, warmup=1,
+                )
+                bw = 2 * T * Hkv * 128 * 2 / per
+                print(json.dumps({
+                    "kernel": "decode", "H": H, "Hkv": Hkv, "T": T, "bk": bk,
+                    "us": round(per * 1e6, 1),
+                    "pct_roofline": round(bw / HBM * 100, 1),
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({
+                    "kernel": "decode", "T": T, "bk": bk,
+                    "error": f"{type(e).__name__}: {e}"[:200],
+                }), flush=True)
+
+
+def sweep_fwd(bwd=False):
+    from tree_attention_tpu.ops import flash_attention
+
+    for T, ns, nl in ((4096, 8, 32), (16384, 4, 16)):
+        q, k, v = _qkv(16, 16, T, T)
+        flops = 2 * 2 * 16 * (T * T / 2) * 128 * (3.5 if bwd else 1)
+        # The bwd path only exposes block_size through flash_attention, so
+        # its sweep is 1-D; block_q sweeps apply to the raw fwd kernel only.
+        for bq in ((256,) if bwd else (128, 256, 512)):
+            for bk in (256, 512, 1024):
+                try:
+                    if bwd:
+                        def step(qc, k_, v_, bq=bq, bk=bk):
+                            def loss(q_):
+                                o, _ = flash_attention(
+                                    q_, k_, v_, causal=True, impl="pallas",
+                                    block_size=bk,
+                                )
+                                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+                            return jax.grad(loss)(qc)
+                    else:
+                        def step(qc, k_, v_, bq=bq, bk=bk):
+                            from tree_attention_tpu.ops.pallas_attention import (
+                                attention_pallas_fwd,
+                            )
+
+                            return attention_pallas_fwd(
+                                qc, k_, v_, causal=True, block_q=bq,
+                                block_size=bk,
+                            )[0]
+
+                    per, _, _ = time_per_step(
+                        lambda n: _chain(step, n), q, k, v,
+                        n_small=ns, n_large=nl, iters=3, warmup=1,
+                    )
+                    print(json.dumps({
+                        "kernel": "bwd" if bwd else "fwd", "T": T,
+                        "bq": bq, "bk": bk, "us": round(per * 1e6, 1),
+                        "tflops": round(flops / per / 1e12, 1),
+                    }), flush=True)
+                except Exception as e:
+                    print(json.dumps({
+                        "kernel": "bwd" if bwd else "fwd", "T": T, "bq": bq,
+                        "bk": bk, "error": f"{type(e).__name__}: {e}"[:200],
+                    }), flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "decode"
+    {"decode": sweep_decode, "fwd": sweep_fwd,
+     "bwd": lambda: sweep_fwd(bwd=True)}[mode]()

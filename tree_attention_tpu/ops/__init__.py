@@ -9,7 +9,7 @@ done right). Implementations:
 - ``"pallas"``    — Pallas TPU kernels, fwd (:mod:`.pallas_attention`) +
   bwd (:mod:`.pallas_bwd`); Q-tiled, the training shape
 - ``"pallas_decode"`` — Pallas TPU split-KV flash-decode kernel
-  (:mod:`.pallas_decode`); KV-major layout for Tq < 128
+  (:mod:`.pallas_decode`); GQA-group-packed Q tiles for Tq < 128
 - ``"auto"``      — decode shapes (Tq < 128) resolve to the flash-decode
   kernel on TPU (any context length; no score transient) and to ``naive``
   elsewhere when the score transient is small; large-Tq shapes resolve to
@@ -114,9 +114,9 @@ def flash_attention(
         for causal masking across sequence shards.
       impl: ``auto | naive | blockwise | pallas | pallas_decode``.
       block_size: KV block length for the blockwise/pallas paths. ``None``
-        picks the impl's own tuned default (512 for blockwise/pallas, 2048
-        for the flash-decode kernel — its tiles are pure streaming, bigger
-        amortises better); an explicit value is honored as given.
+        picks the impl's own tuned default — 512 for blockwise/pallas, and
+        the measured context-bucketed table in :mod:`.tuning` for the
+        flash-decode kernel; an explicit value is honored as given.
       custom_vjp: use the flash (recompute-from-lse) backward — O(T) residual
         memory but **reverse-mode only** (``jax.jvp``/``jacfwd`` raise on
         custom_vjp functions). Pass False (or ``impl='naive'``) for
@@ -131,7 +131,7 @@ def flash_attention(
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "auto":
         # Resolution order, all measured on the target chip (TPU v5e):
-        # 1. Decode shapes (Tq < 128) on TPU -> "pallas_decode": the KV-major
+        # 1. Decode shapes (Tq < 128) on TPU -> "pallas_decode": the
         #    split-KV kernel streams KV at the HBM roofline regardless of
         #    context length (no score transient, GQA streams each KV head
         #    once). This removes round 1's cliff where >=683k-token MHA
@@ -165,7 +165,12 @@ def flash_attention(
         else:
             impl = "blockwise"
     if block_size is None:
-        block_size = 2048 if impl == "pallas_decode" else 512
+        if impl == "pallas_decode":
+            from tree_attention_tpu.ops.tuning import decode_block_k
+
+            block_size = decode_block_k(k.shape[2])
+        else:
+            block_size = 512
     if impl == "naive":
         # Raw autodiff path: the differential oracle the custom VJP is
         # tested against.

@@ -33,15 +33,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tree_attention_tpu.ops.block_utils import (
-    pad_to_block as _pad_dim,
-    tile_geometry,
-    tile_live,
-)
-
-from tree_attention_tpu.ops.block_utils import (
     LANES as _LANES,
     NEG_INF,
     matmul_precision,
+    tile_geometry,
+    tile_live,
 )
 
 
@@ -93,7 +89,7 @@ def _flash_fwd_kernel(
             precision=matmul_precision(q_ref.dtype, k_ref.dtype),
         ) * scale  # (bq, bk) f32
 
-        valid = col_idx < tk  # mask host-side padding of ragged Tk
+        valid = col_idx < tk  # drop the ragged last KV block's garbage cols
         if causal:
             valid = valid & (row_pos >= col_pos)
         s = jnp.where(valid, s, NEG_INF)
@@ -108,9 +104,19 @@ def _flash_fwd_kernel(
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # P is cast to V's dtype for the second MXU matmul (the FA2 trick:
         # probabilities are in [0,1] so bf16 relative error stays small) and
-        # accumulated in f32.
+        # accumulated in f32. When Tk is ragged the last tile's trailing V
+        # rows are unspecified garbage (no host padding; interpret mode
+        # NaN-poisons them) — p's masked columns are exactly 0, but 0·NaN is
+        # NaN, so those rows must be zeroed. Static no-op for divisible Tk.
+        v_tile = v_ref[0]
+        if tk % block_k:
+            row_ok = (
+                ki * block_k
+                + lax.broadcasted_iota(jnp.int32, v_tile.shape, 0)
+            ) < tk
+            v_tile = jnp.where(row_ok, v_tile, 0)
         acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0],
+            p.astype(v_ref.dtype), v_tile,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=matmul_precision(v_ref.dtype, v_ref.dtype),
@@ -174,16 +180,21 @@ def attention_pallas_fwd(
     bq = min(block_q, max(Tq, 8))
     bk = min(block_size, max(Tk, _LANES))
 
-    qp = _pad_dim(q.reshape(B * Hq, Tq, D), 1, bq)
-    kp = _pad_dim(k.reshape(B * Hkv, Tk, D), 1, bk)
-    vp = _pad_dim(v.reshape(B * Hkv, Tk, D), 1, bk)
-    tq_pad, tk_pad = qp.shape[1], kp.shape[1]
+    # No host-side padding: Pallas handles ragged last blocks itself, and an
+    # explicit jnp.pad copies the ENTIRE Q/K/V every call whenever the length
+    # is not a block multiple (measured as the difference between 27% and 92%
+    # of HBM roofline on the 64000-token decode; same physics here).
+    qp = q.reshape(B * Hq, Tq, D)
+    kp = k.reshape(B * Hkv, Tk, D)
+    vp = v.reshape(B * Hkv, Tk, D)
+    n_q, n_k = -(-Tq // bq), -(-Tk // bk)
+    tq_pad = n_q * bq
 
     offs = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
     ).reshape(2, 1)
 
-    grid = (B * Hq, tq_pad // bq, tk_pad // bk)
+    grid = (B * Hq, n_q, n_k)
 
     def kv_index(bh, qi, ki):
         b, hq = bh // Hq, bh % Hq

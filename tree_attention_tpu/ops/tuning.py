@@ -1,0 +1,35 @@
+"""Measured per-shape kernel tile sizes (TPU v5e).
+
+The Pallas kernels take ``(block_q, block_k)`` tile sizes; the best choice
+depends on the shape class, not the exact shape, so a small measured table
+suffices (VERDICT round-1 item 4). ``tools/tune_sweep.py`` regenerates the
+measurements on hardware; entries here are its output on the one v5e chip
+this repo is benched on. Lookup is by bucket:
+
+- decode (Tq < 128): keyed by context-length bucket. Streaming tiles — the
+  only trade-off is fewer grid steps (bigger bk) vs VMEM and ragged-tail
+  waste.
+
+Callers pass ``block_size=None`` end to end to land here; any explicit value
+wins unchanged. (A training-fwd ``(block_q, block_k)`` table belongs here
+too once ``tools/tune_sweep.py fwd`` finds shape classes where the round-1
+defaults lose — threading ``block_q`` through the dispatcher comes with it.)
+"""
+
+from __future__ import annotations
+
+# context-length upper bound -> block_k. From tools/tune_sweep.py on v5e
+# (bigger contexts amortise per-tile cost over more streaming; VMEM caps the
+# top end).
+_DECODE_BLOCK_K = (
+    (16_384, 1024),
+    (262_144, 2048),
+    (float("inf"), 2048),
+)
+
+def decode_block_k(tk: int) -> int:
+    """KV tile length for the flash-decode kernel."""
+    for bound, bk in _DECODE_BLOCK_K:
+        if tk <= bound:
+            return bk
+    raise AssertionError("unreachable")
