@@ -18,6 +18,7 @@
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
 #include <cerrno>
+#include <ctime>
 
 #include <atomic>
 #include <cmath>
@@ -235,7 +236,31 @@ extern char** environ;
 // the child between fork() and exec must only make async-signal-safe calls:
 // each rank's environment array is fully built in the parent; the child does
 // nothing but execvpe + _exit.
+// Supervised variant: fail-fast rank monitoring. Polls all ranks; when one
+// exits nonzero (or is signalled) the rest get SIGTERM, then SIGKILL after
+// grace_ms — so a crashed rank cannot leave its peers hung in a collective
+// (the reference's failure mode: any rank crash deadlocks the NCCL
+// allreduce forever, /root/reference/model.py:108). timeout_ms > 0 bounds
+// the whole run; expiry kills every rank and reports status 124 for the
+// still-running ones (the `timeout(1)` convention). timeout_ms == 0 means
+// no deadline. Returns the number of nonzero statuses, -1 on fork failure.
+int ta_launch_processes_supervised(const char* const* argv, int nprocs,
+                                   int timeout_ms, int grace_ms,
+                                   int* statuses);
+
 int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
+  return ta_launch_processes_supervised(argv, nprocs, 0, 2000, statuses);
+}
+
+static int64_t ta_now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+int ta_launch_processes_supervised(const char* const* argv, int nprocs,
+                                   int timeout_ms, int grace_ms,
+                                   int* statuses) {
   std::vector<pid_t> pids(nprocs);
 
   // Parent-side env construction (one array per rank).
@@ -273,18 +298,63 @@ int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
     }
     pids[r] = pid;
   }
+  // Supervision loop: reap any child as it exits; fail-fast on the first
+  // nonzero status; enforce the deadline. -1 in `code` marks "still
+  // running".
+  std::vector<int> code(nprocs, -1);
+  const int64_t t0 = ta_now_ms();
+  int64_t kill_deadline = -1;  // set once termination has been requested
+  bool terminating = false;
+  bool timed_out = false;
+  int remaining = nprocs;
+  while (remaining > 0) {
+    int st = 0;
+    pid_t w = waitpid(-1, &st, WNOHANG);
+    if (w < 0 && errno == EINTR) continue;
+    if (w > 0) {
+      for (int r = 0; r < nprocs; ++r) {
+        if (pids[r] == w) {
+          code[r] = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
+          --remaining;
+          if (code[r] != 0 && !terminating) {
+            // Fail fast: peers of a dead rank would block in their next
+            // collective forever.
+            terminating = true;
+            kill_deadline = ta_now_ms() + grace_ms;
+            for (int k = 0; k < nprocs; ++k)
+              if (code[k] < 0) kill(pids[k], SIGTERM);
+          }
+          break;
+        }
+      }
+      continue;
+    }
+    // No child ready: check deadlines, then sleep briefly.
+    const int64_t now = ta_now_ms();
+    if (!terminating && timeout_ms > 0 && now - t0 >= timeout_ms) {
+      terminating = true;
+      timed_out = true;
+      kill_deadline = now + grace_ms;
+      for (int k = 0; k < nprocs; ++k)
+        if (code[k] < 0) kill(pids[k], SIGTERM);
+    }
+    if (terminating && now >= kill_deadline) {
+      for (int k = 0; k < nprocs; ++k)
+        if (code[k] < 0) kill(pids[k], SIGKILL);
+      kill_deadline = now + 60000;  // SIGKILL cannot be ignored; reap soon
+    }
+    struct timespec nap = {0, 20 * 1000 * 1000};  // 20 ms
+    nanosleep(&nap, nullptr);
+  }
   int failures = 0;
   for (int r = 0; r < nprocs; ++r) {
-    int st = 0;
-    pid_t w;
-    while ((w = waitpid(pids[r], &st, 0)) < 0 && errno == EINTR) {}
-    // A persistent waitpid error means the rank's status is unknown; report
-    // it as a failure rather than defaulting st=0 to "exited cleanly".
-    const int code = (w < 0) ? 255
-                             : WIFEXITED(st) ? WEXITSTATUS(st)
-                                             : 128 + WTERMSIG(st);
-    if (statuses) statuses[r] = code;
-    if (code != 0) ++failures;
+    int c = code[r] < 0 ? 255 : code[r];
+    // Ranks killed by the deadline report 124 (the timeout(1) convention)
+    // rather than 128+SIGTERM/KILL, so callers can tell "hung past the
+    // deadline" from "crashed".
+    if (timed_out && (c == 128 + SIGTERM || c == 128 + SIGKILL)) c = 124;
+    if (statuses) statuses[r] = c;
+    if (c != 0) ++failures;
   }
   return failures;
 }

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from tree_attention_tpu import host_runtime as hr
+from tree_attention_tpu.host_runtime import launch_local
 
 needs_native = pytest.mark.skipif(
     not hr.native_available(), reason="native library unavailable"
@@ -88,10 +89,12 @@ class TestLauncher:
         assert fails == 0 and statuses == [0, 0, 0]
 
     def test_per_rank_exit_status(self):
+        # failfast=False: run-to-completion, every rank's own status (the
+        # supervised default would kill slower peers once rank 1 exits 1).
         fails, statuses = hr.launch_local(
             [sys.executable, "-c",
              "import os; raise SystemExit(int(os.environ['JAX_PROCESS_INDEX']))"],
-            3,
+            3, failfast=False,
         )
         assert fails == 2 and statuses == [0, 1, 2]
 
@@ -103,3 +106,53 @@ class TestLauncher:
     def test_nprocs_validation(self):
         with pytest.raises(ValueError):
             hr.launch_local(["true"], 0)
+
+
+class TestSupervisedLaunch:
+    """Fail-fast rank supervision: the reference's crashed-rank deadlock
+    (any rank death hangs the NCCL allreduce forever, model.py:108) cannot
+    happen — peers are killed, statuses reported."""
+
+    def test_failing_rank_kills_hung_peers(self):
+        import sys
+        import time as _t
+
+        # Rank 0 exits 3 immediately; every other rank sleeps "forever".
+        code = (
+            "import os, sys, time\n"
+            "r = int(os.environ['JAX_PROCESS_INDEX'])\n"
+            "sys.exit(3) if r == 0 else time.sleep(600)\n"
+        )
+        t0 = _t.monotonic()
+        failures, statuses = launch_local(
+            [sys.executable, "-c", code], 3, grace=0.5
+        )
+        elapsed = _t.monotonic() - t0
+        assert elapsed < 30, f"supervision took {elapsed:.1f}s"
+        assert failures == 3
+        assert statuses[0] == 3
+        # Peers die by TERM (or KILL if they ignored it) — not timeout 124.
+        assert all(s in (128 + 15, 128 + 9) for s in statuses[1:])
+
+    def test_timeout_kills_and_reports_124(self):
+        import sys
+        import time as _t
+
+        code = "import time; time.sleep(600)\n"
+        t0 = _t.monotonic()
+        failures, statuses = launch_local(
+            [sys.executable, "-c", code], 2, timeout=1.0, grace=0.5
+        )
+        elapsed = _t.monotonic() - t0
+        assert elapsed < 30, f"timeout enforcement took {elapsed:.1f}s"
+        assert failures == 2
+        assert statuses == [124, 124]
+
+    def test_all_clean_ranks_unaffected(self):
+        import sys
+
+        failures, statuses = launch_local(
+            [sys.executable, "-c", "pass"], 3, timeout=60.0
+        )
+        assert failures == 0
+        assert statuses == [0, 0, 0]

@@ -60,10 +60,10 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
         if skip:
             skip = False
             continue
-        if a == "--launch":
+        if a in ("--launch", "--launch-timeout"):
             skip = True
             continue
-        if a.startswith("--launch="):
+        if a.startswith(("--launch=", "--launch-timeout=")):
             continue
         child_args.append(a)
     cmd = [sys.executable, "-m", "tree_attention_tpu", *child_args]
@@ -74,7 +74,9 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
     prev = os.environ.get("TA_COORDINATOR")
     os.environ["TA_COORDINATOR"] = f"localhost:{_pick_free_port()}"
     try:
-        failures, statuses = launch_local(cmd, cfg.launch)
+        failures, statuses = launch_local(
+            cmd, cfg.launch, timeout=cfg.launch_timeout
+        )
     finally:
         if prev is None:
             del os.environ["TA_COORDINATOR"]

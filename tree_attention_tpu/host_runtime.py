@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import ctypes
 import os
+import signal
 import subprocess
+import time
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -97,6 +99,19 @@ def load_native() -> Optional[ctypes.CDLL]:
         lib.ta_launch_processes.restype = ctypes.c_int
         lib.ta_launch_processes.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        if not hasattr(lib, "ta_launch_processes_supervised"):
+            # A prebuilt .so from before this symbol existed whose mtime
+            # defeated the staleness check: treat the native runtime as
+            # unavailable rather than AttributeError-ing at call time.
+            log.warning("stale libtreeattn_host.so (missing supervised "
+                        "launcher); using the pure-python fallbacks")
+            return None
+        lib.ta_launch_processes_supervised.restype = ctypes.c_int
+        lib.ta_launch_processes_supervised.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
         ]
         _lib = lib
@@ -228,30 +243,97 @@ class HostDataPipeline:
 # ---------------------------------------------------------------------------
 
 
-def launch_local(argv: Sequence[str], nprocs: int) -> Tuple[int, List[int]]:
+def launch_local(
+    argv: Sequence[str],
+    nprocs: int,
+    *,
+    timeout: Optional[float] = None,
+    grace: float = 2.0,
+    failfast: bool = True,
+) -> Tuple[int, List[int]]:
     """Run ``nprocs`` copies of ``argv``, each with ``JAX_PROCESS_INDEX`` /
     ``TA_NUM_PROCESSES`` exported; returns (failure_count, per-rank statuses).
 
     The reference's ``mp.spawn(main, nprocs=N)`` (``model.py:165``), as an
-    exec-based launcher (no fork-inheriting a possibly-initialised JAX).
+    exec-based launcher (no fork-inheriting a possibly-initialised JAX) with
+    **fail-fast rank supervision**: the first rank to die non-zero gets its
+    peers SIGTERMed (SIGKILL after ``grace`` seconds) instead of leaving them
+    blocked forever in their next collective — the reference's failure mode
+    (a crashed rank deadlocks the allreduce at ``model.py:108``). With
+    ``timeout`` set, ranks still running at the deadline are killed and
+    report status 124 (the ``timeout(1)`` convention). ``failfast=False``
+    restores run-to-completion semantics (every rank's own exit status, no
+    peer killing) — for workloads whose ranks are independent.
     """
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    if not failfast and timeout:
+        raise ValueError("timeout requires failfast=True")
     lib = load_native()
     if lib is not None:
         c_argv = (ctypes.c_char_p * (len(argv) + 1))(
             *[a.encode() for a in argv], None
         )
         statuses = (ctypes.c_int * nprocs)()
-        failures = lib.ta_launch_processes(c_argv, nprocs, statuses)
+        if failfast:
+            # timeout in (None, 0) = no deadline, the timeout(1) convention.
+            failures = lib.ta_launch_processes_supervised(
+                c_argv, nprocs,
+                0 if not timeout else max(1, int(timeout * 1000)),
+                max(1, int(grace * 1000)),
+                statuses,
+            )
+        else:
+            failures = lib.ta_launch_processes(c_argv, nprocs, statuses)
         if failures < 0:
-            raise OSError("fork failed in ta_launch_processes")
+            raise OSError("fork failed in the native launcher")
         return failures, list(statuses)
+    # Pure-python fallback, subprocess-based.
     procs = []
     for r in range(nprocs):
         env = dict(os.environ)
         env["JAX_PROCESS_INDEX"] = str(r)
         env["TA_NUM_PROCESSES"] = str(nprocs)
         procs.append(subprocess.Popen(list(argv), env=env))
-    statuses = [p.wait() for p in procs]
-    return sum(1 for s in statuses if s != 0), statuses
+    if not failfast:
+        sts = [p.wait() for p in procs]
+        sts = [128 - s if s < 0 else s for s in sts]
+        return sum(1 for s in sts if s != 0), sts
+    deadline = None if not timeout else time.monotonic() + timeout
+    statuses: List[Optional[int]] = [None] * nprocs
+    timed_out = False
+    terminating = False
+    kill_at = None
+    while any(s is None for s in statuses):
+        for i, p in enumerate(procs):
+            if statuses[i] is None and p.poll() is not None:
+                statuses[i] = p.returncode
+                if p.returncode != 0 and not terminating:
+                    terminating = True
+                    kill_at = time.monotonic() + grace
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+        now = time.monotonic()
+        if not terminating and deadline is not None and now >= deadline:
+            terminating = True
+            timed_out = True
+            kill_at = now + grace
+            for q in procs:
+                if q.poll() is None:
+                    q.terminate()
+        if terminating and kill_at is not None and now >= kill_at:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            kill_at = now + 60.0
+        time.sleep(0.02)
+    out = []
+    for s in statuses:
+        c = s if s is not None else 255
+        if c < 0:
+            c = 128 - c  # Popen reports -SIGNUM
+        if timed_out and c in (128 + signal.SIGTERM, 128 + signal.SIGKILL):
+            c = 124
+        out.append(c)
+    return sum(1 for c in out if c != 0), out

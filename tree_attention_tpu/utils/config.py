@@ -53,6 +53,7 @@ class RunConfig:
     mesh: Optional[str] = None  # e.g. "seq=8" or "data=2,seq=2,model=2"
     n_virtual_cpu: int = 0  # >0: force N virtual CPU devices (tests/emulation)
     launch: int = 0  # >1: respawn N coordinated processes (multi-host shape)
+    launch_timeout: Optional[float] = None  # seconds; kill all ranks at expiry
     impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
     block_size: Optional[int] = None  # None -> impl-appropriate default
     seq_layout: str = "contiguous"  # contiguous | zigzag (train mode, seq>1)
@@ -118,7 +119,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--launch", type=int, default=d.launch, metavar="N",
                    help="spawn N coordinated local processes (the multi-host "
                         "shape: one jax.distributed cluster, devices pooled "
-                        "across processes) and run this command in each")
+                        "across processes) and run this command in each; a "
+                        "rank that dies fail-fast-kills its peers")
+    p.add_argument("--launch-timeout", type=float, default=d.launch_timeout,
+                   metavar="SEC", help="deadline for the whole --launch run; "
+                   "ranks alive at expiry are killed (status 124)")
     p.add_argument("--batch", type=int, default=d.batch)
     p.add_argument("--seq-len", type=int, default=d.seq_len)
     p.add_argument("--q-len", type=int, default=d.q_len)
