@@ -173,3 +173,29 @@ def test_generate_temperature_sampling_shape():
     )
     assert toks.shape == (2, 5)
     assert int(jnp.min(toks)) >= 0 and int(jnp.max(toks)) < CFG.vocab_size
+
+
+@pytest.mark.parametrize("tq", [1, 4, 256])
+def test_flash_decode_tpu_branch_interpret(monkeypatch, tq):
+    """Exercise the TPU dispatch branch of flash_decode (kernels in
+    interpret mode): small Tq takes the flash-decode kernel, prefill-sized
+    Tq the Q-tiled kernel — both must match the oracle with cache-style
+    q_position masking."""
+    import tree_attention_tpu.ops as ops_pkg
+    from tree_attention_tpu.ops.decode import flash_decode
+    from tree_attention_tpu.ops import attention_naive
+
+    monkeypatch.setattr(ops_pkg, "_on_tpu", lambda q=None: True)
+
+    rng = np.random.default_rng(21)
+    B, Hq, Hkv, D, cap = 1, 4, 2, 32, 512
+    length = 400  # valid prefix of the cache; the tail is masked future
+    q = jnp.asarray(rng.standard_normal((B, Hq, tq, D), np.float32))
+    k = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    v = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    out, lse = flash_decode(q, k, v, q_position=length - tq)
+    ref_out, ref_lse = attention_naive(
+        q, k, v, causal=True, q_offset=length - tq
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)

@@ -86,12 +86,28 @@ def flash_decode(
         and _on_tpu(q)
         and _pallas_available()
     ):
-        from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+        if Tq < 128:
+            from tree_attention_tpu.ops.pallas_decode import (
+                attention_pallas_decode,
+            )
 
-        return attention_pallas_decode(
+            from tree_attention_tpu.ops.tuning import decode_block_k
+
+            return attention_pallas_decode(
+                q, k, v, causal=True, scale=scale,
+                q_offset=q_position, kv_offset=0,
+                block_size=decode_block_k(Tk) if block_size is None
+                else block_size,
+            )
+        # Prefill-sized Tq: the decode kernel's group packing would spill
+        # into multiple Q tiles, each re-streaming the whole KV buffer; the
+        # Q-tiled training kernel reads KV once per Q tile by design.
+        from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+
+        return attention_pallas_fwd(
             q, k, v, causal=True, scale=scale,
             q_offset=q_position, kv_offset=0,
-            block_size=2048 if block_size is None else block_size,
+            block_size=512 if block_size is None else block_size,
         )
 
     block_size = 512 if block_size is None else block_size
