@@ -156,21 +156,18 @@ def flash_attention(
         # raw Pallas forwards have no autodiff rules, so that request keeps
         # the jnp impls whenever one is viable at the shape.
         naive_ok = Tq <= 8 and transient_bytes <= 128 * 1024 * 1024
-        if Tq < 128 and pallas_ok and (custom_vjp or not naive_ok):
-            impl = "pallas_decode"
+        if pallas_ok and (custom_vjp or not naive_ok or Tq >= 128):
+            from tree_attention_tpu.ops.tuning import tpu_kernel_for
+
+            impl = tpu_kernel_for(Tq)
         elif naive_ok:
             impl = "naive"
-        elif Tq >= 128 and pallas_ok:
-            impl = "pallas"
         else:
             impl = "blockwise"
     if block_size is None:
-        if impl == "pallas_decode":
-            from tree_attention_tpu.ops.tuning import decode_block_k
+        from tree_attention_tpu.ops.tuning import default_block_size
 
-            block_size = decode_block_k(k.shape[2])
-        else:
-            block_size = 512
+        block_size = default_block_size(impl, k.shape[2])
     if impl == "naive":
         # Raw autodiff path: the differential oracle the custom VJP is
         # tested against.

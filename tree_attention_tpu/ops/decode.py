@@ -63,8 +63,9 @@ def flash_decode(
         TPU Pallas kernel is split-KV internally (one chunk per ``block_size``
         KV tile), so this knob is inert there.
       block_size: KV tile length. ``None`` picks the impl-appropriate
-        default (2048 for the TPU kernel, 512 for the chunked path); an
-        explicit value is honored as given on both paths.
+        default (the measured :mod:`~tree_attention_tpu.ops.tuning` table
+        for the flash-decode kernel, 512 for the Q-tiled prefill kernel and
+        the chunked path); an explicit value is honored as given everywhere.
 
     Returns:
       ``(out, lse)``: ``(B, Hq, Tq, D)`` in q's dtype, ``(B, Hq, Tq)`` float32.
@@ -86,28 +87,32 @@ def flash_decode(
         and _on_tpu(q)
         and _pallas_available()
     ):
-        if Tq < 128:
+        # Kernel choice and tile defaults live in ops.tuning (shared with
+        # flash_attention's auto gate). Prefill-sized Tq takes the Q-tiled
+        # kernel: the decode kernel's group packing would spill into
+        # multiple Q tiles, each re-streaming the whole KV buffer.
+        from tree_attention_tpu.ops.tuning import (
+            default_block_size,
+            tpu_kernel_for,
+        )
+
+        impl = tpu_kernel_for(Tq)
+        bk = default_block_size(impl, Tk) if block_size is None else block_size
+        if impl == "pallas_decode":
             from tree_attention_tpu.ops.pallas_decode import (
                 attention_pallas_decode,
             )
 
-            from tree_attention_tpu.ops.tuning import decode_block_k
-
-            return attention_pallas_decode(
-                q, k, v, causal=True, scale=scale,
-                q_offset=q_position, kv_offset=0,
-                block_size=decode_block_k(Tk) if block_size is None
-                else block_size,
+            kernel = attention_pallas_decode
+        else:
+            from tree_attention_tpu.ops.pallas_attention import (
+                attention_pallas_fwd,
             )
-        # Prefill-sized Tq: the decode kernel's group packing would spill
-        # into multiple Q tiles, each re-streaming the whole KV buffer; the
-        # Q-tiled training kernel reads KV once per Q tile by design.
-        from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
 
-        return attention_pallas_fwd(
+            kernel = attention_pallas_fwd
+        return kernel(
             q, k, v, causal=True, scale=scale,
-            q_offset=q_position, kv_offset=0,
-            block_size=512 if block_size is None else block_size,
+            q_offset=q_position, kv_offset=0, block_size=bk,
         )
 
     block_size = 512 if block_size is None else block_size

@@ -33,3 +33,18 @@ def decode_block_k(tk: int) -> int:
         if tk <= bound:
             return bk
     raise AssertionError("unreachable")
+
+
+# The one home of the TPU kernel-dispatch policy shared by flash_attention's
+# auto gate and flash_decode: which Pallas kernel fits a query count, and
+# each impl's default KV tile.
+DECODE_KERNEL_MAX_TQ = 128
+
+
+def tpu_kernel_for(tq: int) -> str:
+    """"pallas_decode" below the Q-tile width, "pallas" (Q-tiled) above."""
+    return "pallas_decode" if tq < DECODE_KERNEL_MAX_TQ else "pallas"
+
+
+def default_block_size(impl: str, tk: int) -> int:
+    return decode_block_k(tk) if impl == "pallas_decode" else 512
