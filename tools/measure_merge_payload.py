@@ -1,0 +1,104 @@
+"""Measure the tree-merge wire format: split (num, den) psum vs packed D+1.
+
+``parallel/tree.py`` can send the safe-softmax merge payload two ways
+(``TREE_ATTN_MERGE_PAYLOAD``): "split" — (num, den) as two operands of one
+``psum``, each lane-aligned — or "packed" — one concatenated tensor with a
+trailing dim of D+1, one lane over a tile boundary (VERDICT round-1 weak
+item 4). This tool times both on the 8-virtual-device CPU mesh (the only
+multi-device surface this repo can reach; single-chip TPU has no cross-device
+collective to measure) and prints one JSON line per layout.
+
+Run:  python tools/measure_merge_payload.py        # parent: spawns both
+      python tools/measure_merge_payload.py child  # one measurement
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def child():
+    import time
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8".strip()
+        )
+    import jax
+
+    # The axon TPU plugin overrides JAX_PLATFORMS; the config API always wins
+    # (same trick as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tree_attention_tpu.parallel import cpu_mesh, tree_attention, tree_decode
+
+    payload = os.environ.get("TREE_ATTN_MERGE_PAYLOAD", "split")
+    mesh = cpu_mesh(8)
+    B, H, D = 1, 8, 128
+    rec = {"payload": payload}
+
+    for name, T in (
+        ("decode_64k", 65536),
+        ("train_2k", 2048),
+    ):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        Tq = 1 if name.startswith("decode") else T
+        q = jax.random.normal(kq, (B, H, Tq, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, H, T, D), jnp.bfloat16)
+        if name.startswith("decode"):
+            f = jax.jit(
+                lambda q, k, v: tree_decode(
+                    q, k, v, mesh=mesh, impl="blockwise"
+                )[0]
+            )
+        else:
+            f = jax.jit(
+                lambda q, k, v: tree_attention(
+                    q, k, v, mesh=mesh, causal=True, impl="blockwise"
+                )[0]
+            )
+        f(q, k, v).block_until_ready()  # compile
+        n = 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(q, k, v)
+        out.block_until_ready()
+        rec[name + "_ms"] = round((time.perf_counter() - t0) / n * 1e3, 2)
+
+    print(json.dumps(rec), flush=True)
+
+
+def parent():
+    for payload in ("split", "packed"):
+        env = dict(os.environ)
+        env["TREE_ATTN_MERGE_PAYLOAD"] = payload
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count=8".strip()
+            )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "child"],
+            env=env, text=True, capture_output=True, timeout=1800,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+        if proc.returncode:
+            print(json.dumps({
+                "payload": payload,
+                "error": proc.stderr[-300:],
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "child":
+        child()
+    else:
+        parent()

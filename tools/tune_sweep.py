@@ -82,9 +82,7 @@ def sweep_fwd(bwd=False):
     for T, ns, nl in ((4096, 8, 32), (16384, 4, 16)):
         q, k, v = _qkv(16, 16, T, T)
         flops = 2 * 2 * 16 * (T * T / 2) * 128 * (3.5 if bwd else 1)
-        # The bwd path only exposes block_size through flash_attention, so
-        # its sweep is 1-D; block_q sweeps apply to the raw fwd kernel only.
-        for bq in ((256,) if bwd else (128, 256, 512)):
+        for bq in (128, 256, 512):
             for bk in (256, 512, 1024):
                 try:
                     if bwd:
@@ -92,7 +90,7 @@ def sweep_fwd(bwd=False):
                             def loss(q_):
                                 o, _ = flash_attention(
                                     q_, k_, v_, causal=True, impl="pallas",
-                                    block_size=bk,
+                                    block_size=bk, block_q=bq,
                                 )
                                 return jnp.sum(o.astype(jnp.float32) ** 2)
 

@@ -101,6 +101,7 @@ def flash_attention(
     kv_offset=0,
     impl: str = "auto",
     block_size: Optional[int] = None,
+    block_q: Optional[int] = None,
     custom_vjp: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Compute attention over the sequence axis, returning ``(out, lse)``.
@@ -114,9 +115,12 @@ def flash_attention(
         for causal masking across sequence shards.
       impl: ``auto | naive | blockwise | pallas | pallas_decode``.
       block_size: KV block length for the blockwise/pallas paths. ``None``
-        picks the impl's own tuned default — 512 for blockwise/pallas, and
-        the measured context-bucketed table in :mod:`.tuning` for the
-        flash-decode kernel; an explicit value is honored as given.
+        picks the impl's default from :mod:`.tuning` — a measured
+        context-bucketed table for the flash-decode kernel, 512 elsewhere;
+        an explicit value is honored as given.
+      block_q: Q-tile length for the Q-tiled Pallas kernel (fwd and bwd).
+        ``None`` picks the tuned default; ignored by the other impls (the
+        flash-decode kernel derives its Q packing from the GQA group).
       custom_vjp: use the flash (recompute-from-lse) backward — O(T) residual
         memory but **reverse-mode only** (``jax.jvp``/``jacfwd`` raise on
         custom_vjp functions). Pass False (or ``impl='naive'``) for
@@ -164,10 +168,13 @@ def flash_attention(
             impl = "naive"
         else:
             impl = "blockwise"
-    if block_size is None:
-        from tree_attention_tpu.ops.tuning import default_block_size
+    if block_size is None or (block_q is None and impl == "pallas"):
+        from tree_attention_tpu.ops.tuning import default_block_q, default_block_size
 
-        block_size = default_block_size(impl, k.shape[2])
+        if block_size is None:
+            block_size = default_block_size(impl, k.shape[2])
+        if block_q is None and impl == "pallas":
+            block_q = default_block_q(q.shape[2], k.shape[2])
     if impl == "naive":
         # Raw autodiff path: the differential oracle the custom VJP is
         # tested against.
@@ -201,13 +208,15 @@ def flash_attention(
             )
         from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
 
+        kw = {} if block_q is None else {"block_q": block_q}
         return attention_pallas_fwd(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset,
-            kv_offset=kv_offset, block_size=block_size,
+            kv_offset=kv_offset, block_size=block_size, **kw,
         )
     from tree_attention_tpu.ops.vjp import flash_attention_vjp
 
     return flash_attention_vjp(
         q, k, v, causal=causal, scale=scale, q_offset=q_offset,
         kv_offset=kv_offset, impl=impl, block_size=block_size,
+        block_q=block_q if impl == "pallas" else None,
     )

@@ -10,10 +10,11 @@ this repo is benched on. Lookup is by bucket:
   only trade-off is fewer grid steps (bigger bk) vs VMEM and ragged-tail
   waste.
 
-Callers pass ``block_size=None`` end to end to land here; any explicit value
-wins unchanged. (A training-fwd ``(block_q, block_k)`` table belongs here
-too once ``tools/tune_sweep.py fwd`` finds shape classes where the round-1
-defaults lose — threading ``block_q`` through the dispatcher comes with it.)
+Callers pass ``block_size=None`` / ``block_q=None`` end to end to land here;
+any explicit value wins unchanged. ``block_q`` is threaded through the
+dispatcher and the custom VJP; :func:`default_block_q` is where a measured
+training-fwd table lands once ``tools/tune_sweep.py fwd`` finds shape
+classes where the round-1 defaults (bq=256, bk=512) lose.
 """
 
 from __future__ import annotations
@@ -48,3 +49,8 @@ def tpu_kernel_for(tq: int) -> str:
 
 def default_block_size(impl: str, tk: int) -> int:
     return decode_block_k(tk) if impl == "pallas_decode" else 512
+
+
+def default_block_q(tq: int, tk: int) -> int:
+    """Q-tile length for the Q-tiled Pallas kernel (fwd + bwd)."""
+    return 256

@@ -46,6 +46,7 @@ class _Cfg(NamedTuple):
     scale: Optional[float]
     impl: str
     block_size: int
+    block_q: Optional[int] = None  # Pallas Q-tile; None = kernel default
 
 
 def _zero_like_offset(x):
@@ -72,9 +73,11 @@ def _raw_forward(cfg, q, k, v, q_offset, kv_offset):
     if cfg.impl == "pallas":
         from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
 
+        kw = {} if cfg.block_q is None else {"block_q": cfg.block_q}
         return attention_pallas_fwd(
             q, k, v, causal=cfg.causal, scale=cfg.scale,
             q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
+            **kw,
         )
     if cfg.impl == "pallas_decode":
         # Decode-shaped forward; its backward runs the blockwise jnp
@@ -100,12 +103,15 @@ def _attn_bwd(cfg, residuals, cotangents):
         from tree_attention_tpu.ops.pallas_bwd import attention_bwd_pallas
 
         bwd = attention_bwd_pallas
+        kw = {} if cfg.block_q is None else {"block_q": cfg.block_q}
     else:
         bwd = attention_bwd_blockwise
+        kw = {}
     dq, dk, dv = bwd(
         q, k, v, out, lse, dout, dlse,
         causal=cfg.causal, scale=cfg.scale,
         q_offset=q_offset, kv_offset=kv_offset, block_size=cfg.block_size,
+        **kw,
     )
     return dq, dk, dv, _zero_like_offset(q_offset), _zero_like_offset(kv_offset)
 
@@ -124,9 +130,13 @@ def flash_attention_vjp(
     kv_offset=0,
     impl: str = "blockwise",
     block_size: int = 512,
+    block_q: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Differentiable attention with the flash (recompute) backward."""
-    cfg = _Cfg(causal=causal, scale=scale, impl=impl, block_size=block_size)
+    cfg = _Cfg(
+        causal=causal, scale=scale, impl=impl, block_size=block_size,
+        block_q=block_q,
+    )
     return _attn(cfg, q, k, v, q_offset, kv_offset)
 
 

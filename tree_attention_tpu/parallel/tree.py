@@ -96,8 +96,13 @@ def unshard_zigzag(x: jax.Array, axis: int, n_shards: int) -> jax.Array:
 # single collective, and each operand keeps a lane-aligned layout (num is a
 # clean (..., D) tile, den a scalar row). "packed" concatenates [num | den]
 # into a trailing dim of D+1 — one logical collective, but one lane over a
-# tile boundary (VERDICT round-1 weak item 4). Env-switchable for measurement;
-# "split" is the default (see the module docstring's measurement note).
+# tile boundary (VERDICT round-1 weak item 4). Measured on the 8-virtual-
+# device mesh (tools/measure_merge_payload.py, 2026-07-30): split wins both
+# shapes — decode-64k 1946 vs 2018 ms, train-2k 621 vs 662 ms — consistent
+# with the concat/slice copies and the unaligned D+1 payload costing more
+# than a second fused reduction operand. "split" is the default; the env
+# switch stays for re-measurement on multi-chip ICI, where the trade could
+# differ (payload count vs alignment, SURVEY.md §7 hard part 5).
 _MERGE_PAYLOAD = __import__("os").environ.get("TREE_ATTN_MERGE_PAYLOAD", "split")
 if _MERGE_PAYLOAD not in ("split", "packed"):
     raise ValueError(
