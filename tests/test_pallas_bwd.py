@@ -124,3 +124,23 @@ def test_end_to_end_grad_impl_pallas_uses_pallas_bwd():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4, err_msg=name
         )
+
+
+def test_bwd_static_offset_cull_matches_traced_offsets():
+    """dQ (dead tiles past the diagonal) and dKV (dead tiles before it) with
+    grid-level culling vs the traced-offset plain grid: identical grads."""
+    rng = np.random.default_rng(7)
+    q, k, v, dout, dlse = make_case(rng, Hq=4, Hkv=2, Tq=256, Tk=384, D=32)
+    out, lse = attention_pallas_fwd(
+        q, k, v, causal=True, q_offset=128, block_size=64, block_q=64
+    )
+    kw = dict(causal=True, scale=None, block_size=64, block_q=64)
+    g_s = attention_bwd_pallas(
+        q, k, v, out, lse, dout, dlse, q_offset=128, kv_offset=0, **kw
+    )
+    g_t = attention_bwd_pallas(
+        q, k, v, out, lse, dout, dlse,
+        q_offset=jnp.asarray(128), kv_offset=jnp.asarray(0), **kw
+    )
+    for a, b in zip(g_s, g_t):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0, rtol=0)

@@ -92,3 +92,20 @@ def test_dispatcher_impl_pallas_end_to_end_grads():
     g_n = jax.grad(loss("naive"), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_p, g_n):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5)
+
+
+def test_static_offset_cull_matches_traced_offsets():
+    """Static int offsets enable grid-level causal culling (DMA-elided dead
+    tiles); traced offsets keep the plain grid. Both must agree exactly —
+    the cull only remaps which block an index map names for iterations whose
+    compute is skipped."""
+    rng = np.random.default_rng(6)
+    # 4 Q tiles x 6 KV tiles with a mid-sequence offset: dead tiles exist on
+    # both sides of the diagonal.
+    q, k, v = make_qkv(rng, Tq=256, Tk=384, D=32)
+    kw = dict(causal=True, q_offset=128, kv_offset=0, block_size=64, block_q=64)
+    out_s, lse_s = attention_pallas_fwd(q, k, v, **kw)
+    kw_traced = dict(kw, q_offset=jnp.asarray(128), kv_offset=jnp.asarray(0))
+    out_t, lse_t = attention_pallas_fwd(q, k, v, **kw_traced)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_t), atol=0, rtol=0)
+    np.testing.assert_allclose(np.asarray(lse_s), np.asarray(lse_t), atol=0, rtol=0)

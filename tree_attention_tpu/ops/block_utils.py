@@ -7,6 +7,7 @@ silently diverge from the forward — so the logic lives once, here.
 
 from __future__ import annotations
 
+import numbers
 from typing import Tuple
 
 import jax
@@ -91,6 +92,72 @@ def tile_live(qi, ki, block_q: int, block_k: int, q_offset, kv_offset,
     if not causal:
         return True
     return (q_offset + qi * block_q + block_q - 1) >= (kv_offset + ki * block_k)
+
+
+def static_offsets(q_offset, kv_offset) -> bool:
+    """Whether both causal shard offsets are compile-time integers.
+
+    True on the unsharded path (offsets are literals); False inside
+    ``shard_map``, where at least one offset is a traced ``axis_index``
+    product. Static offsets let the Pallas index maps cull causally dead
+    tiles at the *grid* level — mapping dead iterations to the nearest live
+    block index, which Pallas's revisiting pipeline turns into an elided
+    DMA — instead of only skipping their compute via ``pl.when``.
+    """
+    return isinstance(q_offset, numbers.Integral) and isinstance(
+        kv_offset, numbers.Integral
+    )
+
+
+def causal_last_live_k(qi, block_q: int, block_k: int, q_offset: int,
+                       kv_offset: int, n_k: int):
+    """Last causally live KV-tile index for Q tile ``qi`` (static offsets).
+
+    Derived from :func:`tile_live`: live iff
+    ``q_offset + qi·bq + bq − 1 >= kv_offset + ki·bk``. Clamped to
+    ``[0, n_k−1]``; a fully-masked Q row clamps to 0 (its compute is skipped
+    either way, the clamp just keeps the index in range).
+    """
+    hi = (q_offset - kv_offset + qi * block_q + block_q - 1) // block_k
+    return jnp.clip(hi, 0, n_k - 1)
+
+
+def causal_first_live_q(ki, block_q: int, block_k: int, q_offset: int,
+                        kv_offset: int, n_q: int):
+    """First causally live Q-tile index for KV tile ``ki`` (static offsets).
+
+    The ceil counterpart of :func:`causal_last_live_k`, clamped to
+    ``[0, n_q−1]``.
+    """
+    lo = -((q_offset + block_q - 1 - kv_offset - ki * block_k) // block_q)
+    return jnp.clip(lo, 0, n_q - 1)
+
+
+def culled_ki(qi, ki, cull, block_q: int, block_k: int, n_k: int):
+    """KV-tile index with grid-level causal culling (index-map side).
+
+    ``cull`` is ``(q_offset, kv_offset)`` as ints or None. Dead tiles past
+    the diagonal repeat the last live block index so the Pallas revisiting
+    pipeline elides their DMA; their compute is independently gated off by
+    ``pl.when(tile_live(...))``. The one definition shared by the fwd and dQ
+    kernels — they must cull identically or diverge silently.
+    """
+    if cull is None:
+        return ki
+    return jnp.minimum(
+        ki, causal_last_live_k(qi, block_q, block_k, cull[0], cull[1], n_k)
+    )
+
+
+def culled_qi(ki, qi, cull, block_q: int, block_k: int, n_q: int):
+    """Q-tile index with grid-level causal culling (dKV mirror of
+    :func:`culled_ki`): dead tiles *before* the diagonal repeat the first
+    live block of their segment."""
+    if cull is None:
+        return qi
+    return jnp.maximum(
+        qi, causal_first_live_q(ki, block_q, block_k, cull[0], cull[1], n_q)
+    )
 
 
 def tile_mask(

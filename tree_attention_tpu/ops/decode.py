@@ -98,6 +98,15 @@ def flash_decode(
 
         impl = tpu_kernel_for(Tq)
         bk = default_block_size(impl, Tk) if block_size is None else block_size
+        # Static int offsets specialise the kernel (grid-level causal cull),
+        # which is right for the fixed full-buffer default but would
+        # recompile per token if a caller advances q_position as a Python
+        # int. Only the default position stays static; any other int is
+        # demoted to a traced scalar (one compile, no cull) — callers who
+        # decode a growing prefix should pass a traced position anyway
+        # (models/decode.py does).
+        if isinstance(q_position, int) and q_position != Tk - Tq:
+            q_position = jnp.asarray(q_position, jnp.int32)
         if impl == "pallas_decode":
             from tree_attention_tpu.ops.pallas_decode import (
                 attention_pallas_decode,

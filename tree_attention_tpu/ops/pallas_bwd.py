@@ -31,7 +31,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tree_attention_tpu.ops.block_utils import (
+    culled_ki,
+    culled_qi,
     pad_to_block,
+    static_offsets,
     tile_geometry,
     tile_live,
 )
@@ -152,10 +155,6 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("causal", "scale", "block_size", "block_q", "interpret"),
-)
 def attention_bwd_pallas(
     q: jax.Array,
     k: jax.Array,
@@ -173,7 +172,49 @@ def attention_bwd_pallas(
     block_q: int = 256,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Pallas backward: same contract as ``attention_bwd_blockwise``."""
+    """Pallas backward: same contract as ``attention_bwd_blockwise``.
+
+    Static integer offsets under ``causal`` enable grid-level culling (see
+    ``attention_pallas_fwd``): the dQ kernel repeats the last live KV block
+    past the diagonal, the dKV kernel repeats the first live Q block before
+    it, and the elided DMAs remove the dead half of the causal HBM traffic.
+    """
+    cull = (
+        (int(q_offset), int(kv_offset))
+        if causal and static_offsets(q_offset, kv_offset)
+        else None
+    )
+    return _attention_bwd_pallas(
+        q, k, v, out, lse, dout, dlse, causal=causal, scale=scale,
+        q_offset=q_offset, kv_offset=kv_offset, block_size=block_size,
+        block_q=block_q, interpret=interpret, cull=cull,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "scale", "block_size", "block_q", "interpret", "cull"
+    ),
+)
+def _attention_bwd_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    dout: jax.Array,
+    dlse: jax.Array,
+    *,
+    causal: bool,
+    scale: Optional[float],
+    q_offset,
+    kv_offset,
+    block_size: int,
+    block_q: int,
+    interpret: Optional[bool],
+    cull: Optional[Tuple[int, int]],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     B, Hq, Tq, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
     G = Hq // Hkv
@@ -224,6 +265,9 @@ def attention_bwd_pallas(
     def kv_from_qrow(bh, *_rest):
         return bh // Hq * Hkv + (bh % Hq) // G
 
+    def ki_live(qi, ki):
+        return culled_ki(qi, ki, cull, bq, bk, n_k)
+
     # ---- dQ ----
     dq = pl.pallas_call(
         functools.partial(
@@ -233,8 +277,8 @@ def attention_bwd_pallas(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki_live(qi, ki), 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki_live(qi, ki), 0)),
             pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
@@ -251,6 +295,9 @@ def attention_bwd_pallas(
         g = gq // n_q
         return b * Hq + hkv * G + g
 
+    def qi_live(ki, gq):
+        return culled_qi(ki, gq % n_q, cull, bq, bk, n_q)
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=s, causal=causal, tk=Tk, block_q=bq,
@@ -259,12 +306,12 @@ def attention_bwd_pallas(
         grid=(B * Hkv, n_k, G * n_q),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
+            pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
             pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
-            pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), gq % n_q, 0)),
+            pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),

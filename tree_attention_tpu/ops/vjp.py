@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tree_attention_tpu.ops.block_utils import matmul_precision
+from tree_attention_tpu.ops.block_utils import matmul_precision, static_offsets
 from tree_attention_tpu.ops.reference import (
     NEG_INF,
     attention_blockwise,
@@ -47,6 +47,12 @@ class _Cfg(NamedTuple):
     impl: str
     block_size: int
     block_q: Optional[int] = None  # Pallas Q-tile; None = kernel default
+    # Static copies of integer offsets. Residuals flow through custom_vjp as
+    # arrays, which would hide compile-time offsets from the backward and
+    # silently disable the Pallas kernels' grid-level causal culling; carrying
+    # them in the (static) cfg keeps fwd and bwd specialised identically.
+    q_off: Optional[int] = None
+    kv_off: Optional[int] = None
 
 
 def _zero_like_offset(x):
@@ -60,6 +66,8 @@ def _attn(cfg: _Cfg, q, k, v, q_offset, kv_offset):
 
 
 def _raw_forward(cfg, q, k, v, q_offset, kv_offset):
+    if cfg.q_off is not None:
+        q_offset, kv_offset = cfg.q_off, cfg.kv_off
     if cfg.impl == "blockwise":
         return attention_blockwise(
             q, k, v, causal=cfg.causal, scale=cfg.scale,
@@ -98,6 +106,8 @@ def _attn_fwd(cfg, q, k, v, q_offset, kv_offset):
 
 def _attn_bwd(cfg, residuals, cotangents):
     q, k, v, out, lse, q_offset, kv_offset = residuals
+    if cfg.q_off is not None:
+        q_offset, kv_offset = cfg.q_off, cfg.kv_off
     dout, dlse = cotangents
     if cfg.impl == "pallas":
         from tree_attention_tpu.ops.pallas_bwd import attention_bwd_pallas
@@ -133,9 +143,12 @@ def flash_attention_vjp(
     block_q: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Differentiable attention with the flash (recompute) backward."""
+    q_off = kv_off = None
+    if static_offsets(q_offset, kv_offset):
+        q_off, kv_off = int(q_offset), int(kv_offset)
     cfg = _Cfg(
         causal=causal, scale=scale, impl=impl, block_size=block_size,
-        block_q=block_q,
+        block_q=block_q, q_off=q_off, kv_off=kv_off,
     )
     return _attn(cfg, q, k, v, q_offset, kv_offset)
 
