@@ -105,7 +105,12 @@ def flash_decode(
         # demoted to a traced scalar (one compile, no cull) — callers who
         # decode a growing prefix should pass a traced position anyway
         # (models/decode.py does).
-        if isinstance(q_position, int) and q_position != Tk - Tq:
+        import numbers
+
+        if (
+            isinstance(q_position, numbers.Integral)
+            and int(q_position) != Tk - Tq
+        ):
             q_position = jnp.asarray(q_position, jnp.int32)
         if impl == "pallas_decode":
             from tree_attention_tpu.ops.pallas_decode import (

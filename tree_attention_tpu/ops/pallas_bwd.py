@@ -13,10 +13,14 @@ Two kernels, the standard split (SURVEY.md §7 hard part 1):
 Both recompute ``p = exp(q·kᵀ·scale − lse)`` from the saved lse (no stored
 probabilities), and consume a host-precomputed
 ``delta = rowsum(dO ⊙ O) − dlse`` — the lse-cotangent folding described in
-:mod:`tree_attention_tpu.ops.vjp`. Padded query rows are neutralised by
-padding lse with ``+inf`` (making ``p`` exactly 0 there); padded key columns
-by the in-kernel range mask. Causally dead tiles skip all compute via
-``pl.when``.
+:mod:`tree_attention_tpu.ops.vjp`. The two per-row f32 residuals ride ONE
+128-lane tensor (lse in lane 0, delta in lane ``DELTA_LANE``): the dKV
+kernel's Q-side blocks change every grid step, making residual reads its
+dominant un-elidable HBM stream, and packing halves them. Padded query rows
+are neutralised by padding lse with ``+inf`` (making ``p`` exactly 0 there);
+padded key columns by the in-kernel range mask. Causally dead tiles skip
+all compute via ``pl.when``, and with static offsets their DMAs are culled
+at the grid level (see ``block_utils.culled_ki``/``culled_qi``).
 """
 
 from __future__ import annotations
@@ -44,6 +48,9 @@ from tree_attention_tpu.ops.block_utils import (
     NEG_INF,
     matmul_precision,
 )
+
+
+DELTA_LANE = 64  # lane carrying delta in the packed residual (lse rides 0)
 
 
 def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
@@ -74,7 +81,7 @@ def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
     return p, ds
 
 
-def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
                dq_ref, dq_scr, *, scale, causal, tk, block_q, block_k):
     qi, ki = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -92,8 +99,8 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         _, ds = _recompute_p_ds(
             q_ref[0], k_ref[0], v_ref[0],
-            do_ref[0], lse_ref[0][:, :1],
-            delta_ref[0][:, :1],
+            do_ref[0], res_ref[0][:, :1],
+            res_ref[0][:, DELTA_LANE:DELTA_LANE + 1],
             scale=scale, causal=causal,
             row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
         )
@@ -109,7 +116,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale, causal, tk, block_q, block_k, n_q):
     ki, gq = pl.program_id(1), pl.program_id(2)
@@ -132,7 +139,8 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         p, ds = _recompute_p_ds(
             q_ref[0], k_ref[0], v_ref[0],
-            do_ref[0], lse_ref[0][:, :1], delta_ref[0][:, :1],
+            do_ref[0], res_ref[0][:, :1],
+            res_ref[0][:, DELTA_LANE:DELTA_LANE + 1],
             scale=scale, causal=causal,
             row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
         )
@@ -250,13 +258,16 @@ def _attention_bwd_pallas(
     if pad_rows:
         lse_f = jnp.pad(lse_f, ((0, 0), (0, pad_rows)), constant_values=jnp.inf)
         delta = jnp.pad(delta, ((0, 0), (0, pad_rows)))
-    # Lane-broadcast layout (B*Hq, tq_pad, 128): TPU tiling rejects (1, bq)
-    # blocks of a 2-D (B*Hq, tq_pad) array (sublane dim 1 is neither 8-aligned
-    # nor full), so per-row scalars ride a 128-lane axis — same layout the
-    # in-tree flash kernels use for their l/m residuals. Costs 128x the lse
-    # HBM footprint; acceptable because lse is 1/D of the out tensor.
-    lse_b = jnp.broadcast_to(lse_f[..., None], (B * Hq, tq_pad, _LANES))
-    delta_b = jnp.broadcast_to(delta[..., None], (B * Hq, tq_pad, _LANES))
+    # Per-row scalars must ride a 128-lane axis (TPU tiling rejects (1, bq)
+    # blocks of a 2-D (B*Hq, tq_pad) array: sublane dim 1 is neither
+    # 8-aligned nor full). Rather than broadcasting lse and delta into two
+    # full 128-lane tensors, both pack into ONE: lse in lane 0, delta in
+    # lane DELTA_LANE. Residual HBM traffic is the dominant stream of the
+    # dKV kernel (its Q-side blocks change every grid step, so nothing is
+    # elided), and the f32 residuals outweigh the bf16 Q/dO tiles — packing
+    # halves that cost.
+    res_b = jnp.zeros((B * Hq, tq_pad, _LANES), jnp.float32)
+    res_b = res_b.at[..., 0].set(lse_f).at[..., DELTA_LANE].set(delta)
 
     offs = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
@@ -281,13 +292,12 @@ def _attention_bwd_pallas(
             pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (kv_from_qrow(bh), ki_live(qi, ki), 0)),
             pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * Hq, tq_pad, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(offs, qp, kp, vp, dop, lse_b, delta_b)
+    )(offs, qp, kp, vp, dop, res_b)
 
     # ---- dK, dV ----
     def q_from_kvrow(bkh, ki, gq):
@@ -311,7 +321,6 @@ def _attention_bwd_pallas(
             pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
             pl.BlockSpec((1, bq, D), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bkh, ki, gq: (q_from_kvrow(bkh, ki, gq), qi_live(ki, gq), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bkh, ki, gq: (bkh, ki, 0)),
@@ -326,7 +335,7 @@ def _attention_bwd_pallas(
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(offs, qp, kp, vp, dop, lse_b, delta_b)
+    )(offs, qp, kp, vp, dop, res_b)
 
     return (
         dq[:, :Tq].reshape(B, Hq, Tq, D),
