@@ -82,8 +82,10 @@ def sweep_fwd(bwd=False):
     for T, ns, nl in ((4096, 8, 32), (16384, 4, 16)):
         q, k, v = _qkv(16, 16, T, T)
         flops = 2 * 2 * 16 * (T * T / 2) * 128 * (3.5 if bwd else 1)
-        for bq in (128, 256, 512):
-            for bk in (256, 512, 1024):
+        # Larger tiles cut the per-Q-row KV re-streaming (O(1/bq) HBM
+        # traffic) at the cost of VMEM; the v5e has room well past these.
+        for bq in (256, 512, 1024):
+            for bk in (512, 1024, 2048):
                 try:
                     if bwd:
                         def step(qc, k_, v_, bq=bq, bk=bk):

@@ -22,6 +22,7 @@ future.
 
 from __future__ import annotations
 
+import numbers
 from typing import Optional, Tuple
 
 import jax
@@ -105,8 +106,6 @@ def flash_decode(
         # demoted to a traced scalar (one compile, no cull) — callers who
         # decode a growing prefix should pass a traced position anyway
         # (models/decode.py does).
-        import numbers
-
         if (
             isinstance(q_position, numbers.Integral)
             and int(q_position) != Tk - Tq

@@ -263,6 +263,13 @@ def _attention_pallas_fwd(
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
+        # Batch-head and Q-tile dims are independent; only the KV dim is
+        # sequential (scratch carries the online-softmax state across it).
+        # Declaring that lets Mosaic split the parallel dims across cores on
+        # megacore parts (v5p/v4); no-op on single-core chips (v5e).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(offs, qp, kp, vp)
 

@@ -296,6 +296,11 @@ def _attention_bwd_pallas(
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * Hq, tq_pad, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        # dq accumulates across the (sequential) KV dim; the rest are
+        # independent — see the fwd kernel's note on megacore splitting.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(offs, qp, kp, vp, dop, res_b)
 
@@ -334,6 +339,10 @@ def _attention_bwd_pallas(
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
+        # dk/dv accumulate across the (sequential) grouped-Q dim.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(offs, qp, kp, vp, dop, res_b)
 

@@ -246,6 +246,11 @@ def attention_pallas_decode(
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
+        # Only the split-KV dim is sequential (carried online-softmax state);
+        # batch-head and Q-tile dims can split across megacore parts.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(offs, qp, kp, vp)
 
