@@ -1,0 +1,92 @@
+"""Property tests for the shared tile/cull helpers in ops.block_utils.
+
+The grid-level DMA elision (culled_ki / culled_qi) is sound only if
+(a) every remapped iteration is one whose compute `tile_live` gates off, and
+(b) the remapped index equals the previous iteration's index across each dead
+run (what makes the Pallas revisiting pipeline skip the copy).
+Both are checked here exhaustively over small geometries.
+"""
+
+import pytest
+
+from tree_attention_tpu.ops.block_utils import (
+    causal_first_live_q,
+    causal_last_live_k,
+    culled_ki,
+    culled_qi,
+    tile_live,
+)
+
+GEOMS = [
+    # (n_q, n_k, bq, bk, q_offset, kv_offset)
+    (4, 4, 64, 64, 0, 0),
+    (4, 8, 64, 32, 0, 0),
+    (3, 5, 128, 64, 64, 0),      # q ahead of kv
+    (5, 3, 32, 128, 0, 128),     # kv block not at 0 (shard-style)
+    (4, 6, 64, 64, 192, 64),
+    (2, 6, 64, 64, 0, 512),      # whole Q range before the shard: all dead
+]
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_culled_ki_only_remaps_dead_tiles_and_elides(geom):
+    n_q, n_k, bq, bk, qo, ko = geom
+    cull = (qo, ko)
+    for qi in range(n_q):
+        prev = None
+        for ki in range(n_k):
+            kj = int(culled_ki(qi, ki, cull, bq, bk, n_k))
+            live = bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
+            if live:
+                assert kj == ki, (geom, qi, ki)
+            else:
+                # Remapped: must repeat the previous iteration's index so the
+                # DMA is elided (first dead tile repeats the last live one,
+                # or 0 when the whole row is dead).
+                expected = prev if prev is not None else 0
+                assert kj == expected, (geom, qi, ki, kj, prev)
+            prev = kj
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_culled_qi_only_remaps_dead_tiles_and_elides(geom):
+    n_q, n_k, bq, bk, qo, ko = geom
+    cull = (qo, ko)
+    for ki in range(n_k):
+        # The dKV grid walks qi 0..n_q-1 per (head, ki) segment.
+        prev = None
+        seen_live = False
+        for qi in range(n_q):
+            qj = int(culled_qi(ki, qi, cull, bq, bk, n_q))
+            live = bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
+            if live:
+                assert qj == qi, (geom, ki, qi)
+                seen_live = True
+            elif not seen_live:
+                # Dead prefix: constant at the first live index (or clamped).
+                if prev is not None:
+                    assert qj == prev, (geom, ki, qi, qj, prev)
+            else:
+                # Under bottom-right causality dead Q tiles precede live
+                # ones; once live, later tiles stay live.
+                raise AssertionError(f"live run not contiguous: {geom} {ki} {qi}")
+            prev = qj
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_boundaries_match_tile_live(geom):
+    """causal_last_live_k / causal_first_live_q are exactly tile_live's
+    boundary (up to clamping)."""
+    n_q, n_k, bq, bk, qo, ko = geom
+    for qi in range(n_q):
+        hi = int(causal_last_live_k(qi, bq, bk, qo, ko, n_k))
+        for ki in range(n_k):
+            live = bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
+            assert live == (ki <= hi) or (not live and hi == 0), (geom, qi, ki)
+    for ki in range(n_k):
+        lo = int(causal_first_live_q(ki, bq, bk, qo, ko, n_q))
+        for qi in range(n_q):
+            live = bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
+            assert live == (qi >= lo) or (not live and lo == n_q - 1), (
+                geom, ki, qi,
+            )
