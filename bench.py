@@ -247,6 +247,16 @@ def main() -> None:
         run("decode_gqa_1m", _decode_record, 32, 4, 1 << 20, 4, 16)
         run("decode_mha_1m", _decode_record, 16, 16, 1 << 20, 2, 8)
         run("train_fwd_bwd", _train_record)
+        # Allocator peak has no reset API, so a per-workload peak is not
+        # observable in one process — record the process-lifetime peak once
+        # (set by the largest workload, the 1M-context decode). Per-workload
+        # peaks come from the CLI bench mode, which runs one workload per
+        # process (bench/harness.py `_peak_hbm`).
+        from tree_attention_tpu.bench.harness import _peak_hbm
+
+        peak = _peak_hbm()
+        if peak is not None:
+            suite["peak_hbm_bytes_process"] = peak
     run("tree_vs_ring_cpu8", _tree_vs_ring_record)
 
     head = suite.get("decode_64k", {})

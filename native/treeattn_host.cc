@@ -244,23 +244,19 @@ extern char** environ;
 // the whole run; expiry kills every rank and reports status 124 for the
 // still-running ones (the `timeout(1)` convention). timeout_ms == 0 means
 // no deadline. Returns the number of nonzero statuses, -1 on fork failure.
-int ta_launch_processes_supervised(const char* const* argv, int nprocs,
-                                   int timeout_ms, int grace_ms,
-                                   int* statuses);
-
-int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
-  return ta_launch_processes_supervised(argv, nprocs, 0, 2000, statuses);
-}
-
 static int64_t ta_now_ms() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
-int ta_launch_processes_supervised(const char* const* argv, int nprocs,
-                                   int timeout_ms, int grace_ms,
-                                   int* statuses) {
+// Shared launcher machinery. failfast == 0 restores run-to-completion
+// semantics: every rank runs to its own exit, no peer killing, no deadline —
+// the contract of the plain ta_launch_processes API (ranks whose work is
+// independent must each report their own status).
+static int ta_launch_common(const char* const* argv, int nprocs,
+                            int timeout_ms, int grace_ms, int failfast,
+                            int* statuses) {
   std::vector<pid_t> pids(nprocs);
 
   // Parent-side env construction (one array per rank).
@@ -298,9 +294,11 @@ int ta_launch_processes_supervised(const char* const* argv, int nprocs,
     }
     pids[r] = pid;
   }
-  // Supervision loop: reap any child as it exits; fail-fast on the first
-  // nonzero status; enforce the deadline. -1 in `code` marks "still
-  // running".
+  // Supervision loop: reap OUR children as they exit (polling each own pid
+  // — waitpid(-1) would steal statuses of unrelated children the caller's
+  // other threads, e.g. pipeline workers, are waiting on); fail-fast on the
+  // first nonzero status when requested; enforce the deadline. -1 in `code`
+  // marks "still running".
   std::vector<int> code(nprocs, -1);
   const int64_t t0 = ta_now_ms();
   int64_t kill_deadline = -1;  // set once termination has been requested
@@ -308,27 +306,35 @@ int ta_launch_processes_supervised(const char* const* argv, int nprocs,
   bool timed_out = false;
   int remaining = nprocs;
   while (remaining > 0) {
-    int st = 0;
-    pid_t w = waitpid(-1, &st, WNOHANG);
-    if (w < 0 && errno == EINTR) continue;
-    if (w > 0) {
-      for (int r = 0; r < nprocs; ++r) {
-        if (pids[r] == w) {
-          code[r] = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
-          --remaining;
-          if (code[r] != 0 && !terminating) {
-            // Fail fast: peers of a dead rank would block in their next
-            // collective forever.
-            terminating = true;
-            kill_deadline = ta_now_ms() + grace_ms;
-            for (int k = 0; k < nprocs; ++k)
-              if (code[k] < 0) kill(pids[k], SIGTERM);
-          }
-          break;
-        }
+    bool reaped = false;
+    for (int r = 0; r < nprocs; ++r) {
+      if (code[r] >= 0) continue;
+      int st = 0;
+      pid_t w = waitpid(pids[r], &st, WNOHANG);
+      if (w < 0 && errno == EINTR) continue;
+      if (w < 0 && errno == ECHILD) {
+        // Someone else reaped this child (a waitpid(-1) elsewhere in the
+        // process, or SIGCHLD set to SIG_IGN). Its true status is lost;
+        // record 255 rather than polling a nonexistent pid forever.
+        code[r] = 255;
+        --remaining;
+        reaped = true;
+        continue;
       }
-      continue;
+      if (w != pids[r]) continue;
+      reaped = true;
+      code[r] = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
+      --remaining;
+      if (failfast && code[r] != 0 && !terminating) {
+        // Fail fast: peers of a dead rank would block in their next
+        // collective forever.
+        terminating = true;
+        kill_deadline = ta_now_ms() + grace_ms;
+        for (int k = 0; k < nprocs; ++k)
+          if (code[k] < 0) kill(pids[k], SIGTERM);
+      }
     }
+    if (reaped) continue;
     // No child ready: check deadlines, then sleep briefly.
     const int64_t now = ta_now_ms();
     if (!terminating && timeout_ms > 0 && now - t0 >= timeout_ms) {
@@ -357,6 +363,21 @@ int ta_launch_processes_supervised(const char* const* argv, int nprocs,
     if (c != 0) ++failures;
   }
   return failures;
+}
+
+// Run-to-completion: every rank's own exit status, no peer killing, no
+// deadline.
+int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
+  return ta_launch_common(argv, nprocs, 0, 2000, /*failfast=*/0, statuses);
+}
+
+// Supervised variant: fail-fast rank monitoring (see the comment block
+// above ta_launch_common).
+int ta_launch_processes_supervised(const char* const* argv, int nprocs,
+                                   int timeout_ms, int grace_ms,
+                                   int* statuses) {
+  return ta_launch_common(argv, nprocs, timeout_ms, grace_ms,
+                          /*failfast=*/1, statuses);
 }
 
 }  // extern "C"

@@ -76,17 +76,25 @@ def test_culled_qi_only_remaps_dead_tiles_and_elides(geom):
 @pytest.mark.parametrize("geom", GEOMS)
 def test_boundaries_match_tile_live(geom):
     """causal_last_live_k / causal_first_live_q are exactly tile_live's
-    boundary (up to clamping)."""
+    boundary; rows/columns with no live tile must clamp to the edge."""
     n_q, n_k, bq, bk, qo, ko = geom
     for qi in range(n_q):
         hi = int(causal_last_live_k(qi, bq, bk, qo, ko, n_k))
-        for ki in range(n_k):
-            live = bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
-            assert live == (ki <= hi) or (not live and hi == 0), (geom, qi, ki)
+        live = [
+            bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
+            for ki in range(n_k)
+        ]
+        if any(live):
+            assert live == [ki <= hi for ki in range(n_k)], (geom, qi, hi)
+        else:
+            assert hi == 0, (geom, qi, hi)
     for ki in range(n_k):
         lo = int(causal_first_live_q(ki, bq, bk, qo, ko, n_q))
-        for qi in range(n_q):
-            live = bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
-            assert live == (qi >= lo) or (not live and lo == n_q - 1), (
-                geom, ki, qi,
-            )
+        live = [
+            bool(tile_live(qi, ki, bq, bk, qo, ko, causal=True))
+            for qi in range(n_q)
+        ]
+        if any(live):
+            assert live == [qi >= lo for qi in range(n_q)], (geom, ki, lo)
+        else:
+            assert lo == n_q - 1, (geom, ki, lo)

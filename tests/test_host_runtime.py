@@ -98,6 +98,19 @@ class TestLauncher:
         )
         assert fails == 2 and statuses == [0, 1, 2]
 
+    def test_run_to_completion_does_not_kill_slow_clean_ranks(self):
+        # Regression: the native run-to-completion path once delegated to the
+        # fail-fast supervisor, so a rank that exited nonzero immediately got
+        # a slower clean rank SIGTERMed — flaky under load. rank 1 fails at
+        # once; rank 0 sleeps, then exits 0, and must still report 0.
+        fails, statuses = hr.launch_local(
+            [sys.executable, "-c",
+             "import os, time; r = int(os.environ['JAX_PROCESS_INDEX']); "
+             "time.sleep(0.8 if r == 0 else 0); raise SystemExit(r)"],
+            2, failfast=False,
+        )
+        assert fails == 1 and statuses == [0, 1]
+
     def test_exec_failure_reported(self):
         fails, statuses = hr.launch_local(["/nonexistent-binary-xyz"], 2)
         assert fails == 2
