@@ -34,6 +34,9 @@
 #include <csignal>
 #include <cstdio>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -128,6 +131,89 @@ void ta_fill_tokens_i32(int32_t* out, size_t n, uint32_t vocab, uint64_t seed,
 }
 
 // ----------------------------------------------------------------------------
+// Memory-mapped token corpus
+// ----------------------------------------------------------------------------
+
+// A corpus is a flat little-endian array of token ids on disk, memory-mapped
+// read-only (the OS page cache is the working set — no user-space copy of
+// the file). dtype_code selects the on-disk width: 4 = int32, 2 = uint16
+// (the common packed-tokenizer format). Sampling is counter-based: row r of
+// batch b starts at Philox(seed, b, r) mod (len − seqlen), so batch content
+// is a pure function of (seed, index) — the same structural reproducibility
+// contract as the synthetic pipeline, and what makes checkpoint resume
+// exact (resume at step k ⇒ identical batch k).
+struct TaCorpus {
+  void* base = nullptr;
+  size_t bytes = 0;
+  int64_t n_tokens = 0;
+  int dtype_code = 4;
+  int fd = -1;
+};
+
+extern "C" {
+
+TaCorpus* ta_corpus_open(const char* path, int dtype_code) {
+  if (dtype_code != 4 && dtype_code != 2) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                    MAP_PRIVATE, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* c = new TaCorpus;
+  c->base = base;
+  c->bytes = static_cast<size_t>(st.st_size);
+  c->n_tokens = static_cast<int64_t>(c->bytes) / dtype_code;
+  c->dtype_code = dtype_code;
+  c->fd = fd;
+  return c;
+}
+
+int64_t ta_corpus_len(const TaCorpus* c) { return c ? c->n_tokens : -1; }
+
+void ta_corpus_close(TaCorpus* c) {
+  if (!c) return;
+  munmap(c->base, c->bytes);
+  close(c->fd);
+  delete c;
+}
+
+// Fill out[rows*(seqlen+1)] with `rows` length-(seqlen+1) windows (input and
+// next-token target share the buffer). Returns 0, or -1 if the corpus is
+// shorter than one window.
+int ta_corpus_fill_batch(const TaCorpus* c, int32_t* out, size_t rows,
+                         size_t seqlen, uint64_t seed, uint64_t batch_idx) {
+  const int64_t window = static_cast<int64_t>(seqlen) + 1;
+  if (!c || c->n_tokens < window) return -1;
+  const uint64_t span = static_cast<uint64_t>(c->n_tokens - window + 1);
+  for (size_t r = 0; r < rows; ++r) {
+    uint32_t blk[4];
+    Philox::block(seed, batch_idx, r, blk);
+    const uint64_t rnd = (static_cast<uint64_t>(blk[0]) << 32) | blk[1];
+    const int64_t off = static_cast<int64_t>(rnd % span);
+    int32_t* dst = out + r * window;
+    if (c->dtype_code == 4) {
+      const int32_t* src = static_cast<const int32_t*>(c->base) + off;
+      std::memcpy(dst, src, window * sizeof(int32_t));
+    } else {
+      const uint16_t* src = static_cast<const uint16_t*>(c->base) + off;
+      for (int64_t i = 0; i < window; ++i)
+        dst[i] = static_cast<int32_t>(src[i]);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+// ----------------------------------------------------------------------------
 // Prefetching batch pipeline
 // ----------------------------------------------------------------------------
 
@@ -136,6 +222,12 @@ struct TaPipeline {
   uint32_t vocab;
   uint64_t seed;
   size_t depth;
+  // Corpus mode: non-null switches workers from synthetic Philox tokens to
+  // mmap'd corpus windows of shape (rows, seqlen+1). The corpus handle is
+  // borrowed — the caller keeps it open for the pipeline's lifetime.
+  const TaCorpus* corpus = nullptr;
+  size_t rows = 0;
+  size_t seqlen = 0;
   std::vector<std::thread> workers;
 
   std::mutex mu;
@@ -160,7 +252,10 @@ struct TaPipeline {
       std::vector<int32_t> batch(batch_elems);
       // Content depends only on (seed, idx): worker count/timing never
       // changes what batch `idx` contains — reproducibility is structural.
-      ta_fill_tokens_i32(batch.data(), batch_elems, vocab, seed, idx);
+      if (corpus)
+        ta_corpus_fill_batch(corpus, batch.data(), rows, seqlen, seed, idx);
+      else
+        ta_fill_tokens_i32(batch.data(), batch_elems, vocab, seed, idx);
       {
         std::lock_guard<std::mutex> lk(mu);
         if (stop) return;
@@ -184,6 +279,29 @@ TaPipeline* ta_pipeline_create(size_t batch_elems, uint32_t vocab,
   p->vocab = vocab;
   p->seed = seed;
   p->depth = static_cast<size_t>(depth);
+  p->next_claim.store(start);
+  p->head = start;
+  for (int i = 0; i < n_workers; ++i)
+    p->workers.emplace_back([p] { p->worker(); });
+  return p;
+}
+
+// Corpus-backed pipeline: batches of shape (rows, seqlen+1) sampled from an
+// open corpus. The corpus must outlive the pipeline.
+TaPipeline* ta_pipeline_create_corpus(TaCorpus* corpus, size_t rows,
+                                      size_t seqlen, uint64_t seed, int depth,
+                                      int n_workers, uint64_t start) {
+  if (!corpus || rows == 0 || seqlen == 0 || depth < 1 || n_workers < 1)
+    return nullptr;
+  if (corpus->n_tokens < static_cast<int64_t>(seqlen) + 1) return nullptr;
+  auto* p = new TaPipeline;
+  p->batch_elems = rows * (seqlen + 1);
+  p->vocab = 0;
+  p->seed = seed;
+  p->depth = static_cast<size_t>(depth);
+  p->corpus = corpus;
+  p->rows = rows;
+  p->seqlen = seqlen;
   p->next_claim.store(start);
   p->head = start;
   for (int i = 0; i < n_workers; ++i)
@@ -317,17 +435,17 @@ static int ta_launch_common(const char* const* argv, int nprocs,
         // process, or SIGCHLD set to SIG_IGN). Its true status is lost;
         // record 255 rather than polling a nonexistent pid forever.
         code[r] = 255;
-        --remaining;
-        reaped = true;
+      } else if (w == pids[r]) {
+        code[r] = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
+      } else {
         continue;
       }
-      if (w != pids[r]) continue;
       reaped = true;
-      code[r] = WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st);
       --remaining;
       if (failfast && code[r] != 0 && !terminating) {
         // Fail fast: peers of a dead rank would block in their next
-        // collective forever.
+        // collective forever. (The stolen-status path counts too — an
+        // unknown exit is not a clean one.)
         terminating = true;
         kill_deadline = ta_now_ms() + grace_ms;
         for (int k = 0; k < nprocs; ++k)

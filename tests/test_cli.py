@@ -195,6 +195,20 @@ class TestCLI:
         assert "host data pipeline" in logs
         assert len(record["losses"]) == 2 and all(l > 0 for l in record["losses"])
 
+    def test_train_corpus_data(self, tmp_path):
+        import numpy as np
+
+        corpus = tmp_path / "toks.bin"
+        (np.arange(4096, dtype="<i4") % 64).tofile(str(corpus))
+        record, logs = run_cli(
+            "--mode", "train", "--device", "cpu", "--seq-len", "32",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "2", "--batch", "1",
+            "--dtype", "float32", "--iters", "1", "--data", str(corpus),
+        )
+        assert "corpus pipeline" in logs
+        assert len(record["losses"]) == 2 and all(l > 0 for l in record["losses"])
+
     def test_log_file_flag(self, tmp_path):
         log = tmp_path / "cli.log"
         run_cli(*TINY, "--log-file", str(log))

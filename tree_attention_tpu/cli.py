@@ -237,7 +237,23 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     start = 0 if start_step is None else start_step + 1
     key = jax.random.PRNGKey(cfg.seed + 1)
     pipe = None
-    if cfg.host_data:
+    corpus = None
+    if cfg.data:
+        from tree_attention_tpu.host_runtime import (
+            HostCorpusPipeline, TokenCorpus, native_available,
+        )
+
+        # Real data: mmap'd token corpus, same resume contract as the
+        # synthetic pipeline (batch k is a pure function of (seed, k)).
+        corpus = TokenCorpus(cfg.data, dtype=cfg.data_dtype)
+        pipe = HostCorpusPipeline(
+            corpus, cfg.batch, cfg.seq_len, cfg.seed + 1, start=start,
+        )
+        log.info(
+            "corpus pipeline: %s (%d tokens, native=%s)",
+            cfg.data, len(corpus), native_available(),
+        )
+    elif cfg.host_data:
         from tree_attention_tpu.host_runtime import HostDataPipeline, native_available
 
         # Batch content is a pure function of (seed, step index), so resume
@@ -255,6 +271,17 @@ def _run_train(cfg: RunConfig, mesh) -> int:
                 seq_len=cfg.seq_len, vocab_size=tcfg.vocab_size, mesh=mesh,
             )
         toks = pipe.next()  # numpy; slice as host views, one transfer each
+        if corpus is not None:
+            # XLA's gather clamps out-of-range ids, which would silently
+            # train on garbage; fail loudly instead. Cheap: a host max over
+            # one batch.
+            hi = int(toks.max())
+            if hi >= tcfg.vocab_size:
+                raise SystemExit(
+                    f"corpus token id {hi} >= --vocab-size "
+                    f"{tcfg.vocab_size} (step {i}); retokenize or raise "
+                    f"--vocab-size"
+                )
         b = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
         if mesh is not None:
             return shard_batch(mesh, b)
@@ -277,6 +304,8 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     finally:
         if pipe is not None:
             pipe.close()
+        if corpus is not None:
+            corpus.close()
         if ckpt is not None:
             ckpt.close()
     # Throughput of the compiled step (last batch, post-compile). Timing

@@ -114,6 +114,23 @@ def load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
         ]
+        if hasattr(lib, "ta_corpus_open"):
+            lib.ta_corpus_open.restype = ctypes.c_void_p
+            lib.ta_corpus_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.ta_corpus_len.restype = ctypes.c_int64
+            lib.ta_corpus_len.argtypes = [ctypes.c_void_p]
+            lib.ta_corpus_close.argtypes = [ctypes.c_void_p]
+            lib.ta_corpus_fill_batch.restype = ctypes.c_int
+            lib.ta_corpus_fill_batch.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_size_t, ctypes.c_size_t,
+                ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.ta_pipeline_create_corpus.restype = ctypes.c_void_p
+            lib.ta_pipeline_create_corpus.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+                ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ]
         _lib = lib
         log.info("native host runtime loaded: %s", _SO_PATH)
         return _lib
@@ -165,7 +182,48 @@ def philox_tokens(
 # ---------------------------------------------------------------------------
 
 
-class HostDataPipeline:
+class _PipelineBase:
+    """Shared native-handle lifecycle for the prefetching pipelines.
+
+    Subclasses set ``self._handle`` (or leave it None for the pure-python
+    fallback), ``self._elems`` and ``self._out_shape`` before returning from
+    ``__init__``, and implement ``_fallback_batch(idx)``. Delivery, the
+    stopped-pipeline error path, close, and context-manager/``__del__``
+    safety live here once.
+    """
+
+    _handle = None  # class default: __del__ is safe pre-__init__
+    _fallback_idx = 0
+
+    def next(self) -> np.ndarray:
+        if self._handle:
+            out = np.empty(self._elems, np.int32)
+            idx = self._lib.ta_pipeline_next(
+                self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            )
+            if idx < 0:
+                raise RuntimeError("pipeline stopped")
+            return out.reshape(self._out_shape)
+        idx = self._fallback_idx
+        self._fallback_idx += 1
+        return self._fallback_batch(idx)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ta_pipeline_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+class HostDataPipeline(_PipelineBase):
     """Prefetching token-batch source: C++ worker threads fill ahead.
 
     Batch ``i`` always has the content of ``philox_tokens(shape, vocab,
@@ -189,11 +247,11 @@ class HostDataPipeline:
         workers: int = 2,
         start: int = 0,
     ):
-        self._handle = None  # before any validation: __del__ must be safe
         self.batch_shape = tuple(int(s) for s in batch_shape)
         self.vocab = int(vocab)
         self.seed = int(seed)
         self._elems = int(np.prod(self.batch_shape))
+        self._out_shape = self.batch_shape
         if self._elems <= 0 or self.vocab <= 0:
             raise ValueError(
                 f"bad pipeline config: shape={batch_shape} vocab={vocab}"
@@ -210,25 +268,100 @@ class HostDataPipeline:
             if not self._handle:
                 raise RuntimeError("ta_pipeline_create failed")
 
-    def next(self) -> np.ndarray:
-        if self._handle:
-            out = np.empty(self._elems, np.int32)
-            idx = self._lib.ta_pipeline_next(
-                self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-            )
-            if idx < 0:
-                raise RuntimeError("pipeline stopped")
-            return out.reshape(self.batch_shape)
-        idx = self._fallback_idx
-        self._fallback_idx += 1
+    def _fallback_batch(self, idx: int) -> np.ndarray:
         return philox_tokens(self.batch_shape, self.vocab, self.seed, idx)
+
+
+# ---------------------------------------------------------------------------
+# Memory-mapped token corpus
+# ---------------------------------------------------------------------------
+
+_CORPUS_DTYPES = {"int32": (4, np.dtype("<i4")), "uint16": (2, np.dtype("<u2"))}
+
+
+def _philox4x32(seed: int, ctr_hi: int, ctr_lo: int):
+    """Pure-python Philox4x32-10 block, bit-identical to the native one —
+    the fallback corpus sampler must pick the same offsets the C++ workers
+    would, so native and fallback deliver identical batches."""
+    M0, M1 = 0xD2511F53, 0xCD9E8D57
+    k0, k1 = seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF
+    c = [ctr_lo & 0xFFFFFFFF, (ctr_lo >> 32) & 0xFFFFFFFF,
+         ctr_hi & 0xFFFFFFFF, (ctr_hi >> 32) & 0xFFFFFFFF]
+    for _ in range(10):
+        p0, p1 = M0 * c[0], M1 * c[2]
+        c = [((p1 >> 32) ^ c[1] ^ k0) & 0xFFFFFFFF, p1 & 0xFFFFFFFF,
+             ((p0 >> 32) ^ c[3] ^ k1) & 0xFFFFFFFF, p0 & 0xFFFFFFFF]
+        k0 = (k0 + 0x9E3779B9) & 0xFFFFFFFF
+        k1 = (k1 + 0xBB67AE85) & 0xFFFFFFFF
+    return c
+
+
+class TokenCorpus:
+    """A flat on-disk array of token ids, memory-mapped (zero-copy reads).
+
+    The native handle mmaps via C++ (``ta_corpus_open``); without the native
+    library a ``np.memmap`` serves the same windows with the same
+    (bit-identical) Philox offsets. ``fill_batch`` returns ``(rows,
+    seqlen+1)`` int32 windows — input and next-token target share the
+    buffer. Batch content is a pure function of ``(seed, batch_idx)``.
+    """
+
+    def __init__(self, path: str, dtype: str = "int32"):
+        self._handle = None
+        self._mm = None
+        if dtype not in _CORPUS_DTYPES:
+            raise ValueError(
+                f"dtype must be one of {sorted(_CORPUS_DTYPES)}, got {dtype!r}"
+            )
+        code, np_dtype = _CORPUS_DTYPES[dtype]
+        self.path = path
+        self.dtype = dtype
+        self._lib = load_native()
+        if self._lib is not None and hasattr(self._lib, "ta_corpus_open"):
+            self._handle = self._lib.ta_corpus_open(path.encode(), code)
+            if not self._handle:
+                raise OSError(f"cannot open corpus {path!r} (dtype {dtype})")
+            self.n_tokens = int(self._lib.ta_corpus_len(self._handle))
+        else:
+            self._mm = np.memmap(path, dtype=np_dtype, mode="r")
+            self.n_tokens = int(self._mm.shape[0])
+
+    def __len__(self) -> int:
+        return self.n_tokens
+
+    def fill_batch(
+        self, rows: int, seqlen: int, seed: int, batch_idx: int
+    ) -> np.ndarray:
+        window = seqlen + 1
+        if self.n_tokens < window:
+            raise ValueError(
+                f"corpus has {self.n_tokens} tokens < one {window}-token window"
+            )
+        if self._handle:
+            out = np.empty(rows * window, np.int32)
+            rc = self._lib.ta_corpus_fill_batch(
+                self._handle,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                rows, seqlen, seed & (2**64 - 1), batch_idx & (2**64 - 1),
+            )
+            if rc != 0:
+                raise RuntimeError("ta_corpus_fill_batch failed")
+            return out.reshape(rows, window)
+        span = self.n_tokens - window + 1
+        out = np.empty((rows, window), np.int32)
+        for r in range(rows):
+            blk = _philox4x32(seed, batch_idx, r)
+            off = ((blk[0] << 32) | blk[1]) % span
+            out[r] = self._mm[off:off + window].astype(np.int32)
+        return out
 
     def close(self) -> None:
         if self._handle:
-            self._lib.ta_pipeline_destroy(self._handle)
+            self._lib.ta_corpus_close(self._handle)
             self._handle = None
+        self._mm = None
 
-    def __enter__(self) -> "HostDataPipeline":
+    def __enter__(self) -> "TokenCorpus":
         return self
 
     def __exit__(self, *exc) -> None:
@@ -236,6 +369,51 @@ class HostDataPipeline:
 
     def __del__(self):
         self.close()
+
+
+class HostCorpusPipeline(_PipelineBase):
+    """Prefetching corpus-batch source: the corpus analogue of
+    :class:`HostDataPipeline` (same ordered-window machinery, same
+    resume-at-``start`` contract). The corpus must stay open for the
+    pipeline's lifetime."""
+
+    def __init__(
+        self,
+        corpus: TokenCorpus,
+        batch: int,
+        seq_len: int,
+        seed: int,
+        *,
+        depth: int = 4,
+        workers: int = 2,
+        start: int = 0,
+    ):
+        if batch < 1 or seq_len < 1:
+            raise ValueError(f"bad pipeline config: batch={batch} seq_len={seq_len}")
+        if start < 0:
+            raise ValueError(f"start must be >= 0, got {start}")
+        self.corpus = corpus
+        self.batch = int(batch)
+        self.seq_len = int(seq_len)
+        self.seed = int(seed)
+        self._elems = self.batch * (self.seq_len + 1)
+        self._out_shape = (self.batch, self.seq_len + 1)
+        self._fallback_idx = start
+        self._lib = load_native()
+        if (
+            self._lib is not None
+            and corpus._handle
+            and hasattr(self._lib, "ta_pipeline_create_corpus")
+        ):
+            self._handle = self._lib.ta_pipeline_create_corpus(
+                corpus._handle, self.batch, self.seq_len,
+                self.seed & (2**64 - 1), int(depth), int(workers), int(start),
+            )
+            if not self._handle:
+                raise RuntimeError("ta_pipeline_create_corpus failed")
+
+    def _fallback_batch(self, idx: int) -> np.ndarray:
+        return self.corpus.fill_batch(self.batch, self.seq_len, self.seed, idx)
 
 
 # ---------------------------------------------------------------------------

@@ -76,6 +76,8 @@ class RunConfig:
 
     # Host data pipeline (train mode).
     host_data: bool = False
+    data: Optional[str] = None       # path to a flat binary token corpus
+    data_dtype: str = "int32"        # on-disk token width: int32 | uint16
 
     # Checkpointing (train mode).
     ckpt_dir: Optional[str] = None
@@ -160,6 +162,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-data", action="store_true", default=d.host_data,
                    help="train mode: feed batches from the native prefetching "
                         "host pipeline instead of on-device RNG")
+    p.add_argument("--data", default=d.data, metavar="PATH",
+                   help="train mode: mmap'd binary token corpus to sample "
+                        "batches from (overrides --host-data's synthetic "
+                        "tokens; token ids must be < --vocab-size)")
+    p.add_argument("--data-dtype", choices=["int32", "uint16"],
+                   default=d.data_dtype,
+                   help="on-disk token width of --data")
     p.add_argument("--ckpt-dir", default=d.ckpt_dir,
                    help="train mode: checkpoint directory (enables saving)")
     p.add_argument("--ckpt-every", type=int, default=d.ckpt_every,
