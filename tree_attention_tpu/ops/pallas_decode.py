@@ -96,12 +96,17 @@ def _flash_decode_kernel(
         # Scores (bq, bk): packed queries on sublanes, KV across lanes.
         # Operands stay in their native dtype (bf16 MXU fast path) with f32
         # accumulation; see matmul_precision for the precision contract.
+        # int8 K/V (the quantized-cache path) casts to bf16 first — exact
+        # for values in [-127, 127], and dot_general rejects mixed dtypes.
+        k_tile = k_ref[0]
+        if k_tile.dtype == jnp.int8:
+            k_tile = k_tile.astype(jnp.bfloat16)
         s = lax.dot_general(
             q_ref[0],
-            k_ref[0],
+            k_tile,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=matmul_precision(q_ref.dtype, k_ref.dtype),
+            precision=matmul_precision(q_ref.dtype, k_tile.dtype),
         ) * scale  # (bq, bk) f32
 
         # Visibility: lane i is KV global position kv_offset + si*bk + i;
@@ -133,16 +138,18 @@ def _flash_decode_kernel(
         # p's masked columns are exactly 0, but 0·NaN = NaN, so those rows
         # must be zeroed. Static no-op for divisible shapes.
         v_tile = v_ref[0]
+        if v_tile.dtype == jnp.int8:
+            v_tile = v_tile.astype(jnp.bfloat16)
         if tk % bk:
             row_ok = (
                 si * bk + lax.broadcasted_iota(jnp.int32, v_tile.shape, 0)
             ) < tk
             v_tile = jnp.where(row_ok, v_tile, 0)
         acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
-            p.astype(v_ref.dtype), v_tile,
+            p.astype(v_tile.dtype), v_tile,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=matmul_precision(v_ref.dtype, v_ref.dtype),
+            precision=matmul_precision(v_tile.dtype, v_tile.dtype),
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -160,6 +167,100 @@ def _flash_decode_kernel(
             empty, NEG_INF, jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)
         )
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def quantize_kv_channelwise(
+    k: jax.Array, v: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-channel symmetric int8 quantization of a KV buffer.
+
+    Returns ``(k_q, v_q, k_scale, v_scale)``: int8 tensors shaped like k/v
+    and float32 scales of shape ``(B, Hkv, 1, D)`` with
+    ``k ≈ k_q * k_scale``. Per-channel (one scale per head-dim lane per KV
+    head) rather than per-token so the decode kernel never touches the
+    scales on the hot KV stream: K's scale folds into Q before the kernel
+    and V's applies to the accumulator in the epilogue — both O(D) per
+    step, not O(T·D).
+    """
+    @jax.jit
+    def q8(x):
+        xf = x.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=2, keepdims=True)
+        scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    k_q, k_s = q8(k)
+    v_q, v_s = q8(v)
+    return k_q, v_q, k_s, v_s
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_size", "interpret"),
+)
+def attention_pallas_decode_q8(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_size: int = 2048,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Split-KV flash decode over an int8-quantized KV buffer.
+
+    Same ``(out, lse)`` contract as :func:`attention_pallas_decode`, computed
+    over the *dequantized* values ``k_q·k_scale`` / ``v_q·v_scale`` — the lse
+    is of the dequantized logits, so the output plugs into the tree merge
+    unchanged. Decode is bandwidth-bound (the kernel's whole job is to
+    stream every KV byte once), so int8 halves the bytes and doubles the
+    tokens/sec ceiling at the same roofline; the scales never ride the KV
+    stream (see :func:`quantize_kv_channelwise`).
+
+    Opt-in: quantization is approximate (≈2–3 decimal digits per channel).
+    The framework's default path stays exact.
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv = k_q.shape[1]
+    if k_q.dtype != jnp.int8 or v_q.dtype != jnp.int8:
+        raise ValueError(
+            f"k_q/v_q must be int8, got {k_q.dtype}/{v_q.dtype}"
+        )
+    if k_scale.shape != (B, Hkv, 1, D) or v_scale.shape != (B, Hkv, 1, D):
+        raise ValueError(
+            f"scales must be (B, Hkv, 1, D) = {(B, Hkv, 1, D)}, got "
+            f"{k_scale.shape}/{v_scale.shape}"
+        )
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    # Fold K's per-channel scale into Q: (q ⊙ k_s)·k_qᵀ == q·(k_q ⊙ k_s)ᵀ.
+    # The fold runs in f32; the folded Q is carried bf16 into the kernel
+    # (the MXU fast path, and the same operand precision the unquantized
+    # bf16 decode runs at).
+    qf = (
+        q.astype(jnp.float32).reshape(B, Hkv, G * Tq, D) * k_scale
+    ).astype(jnp.bfloat16).reshape(B, Hq, Tq, D)
+    # The base split-KV kernel runs the int8 K/V directly (in-kernel bf16
+    # casts, exact for [-127, 127]; no dequant multiplies on the KV stream).
+    out, lse = attention_pallas_decode(
+        qf, k_q, v_q, causal=causal, scale=scale,
+        q_offset=q_offset, kv_offset=kv_offset, block_size=block_size,
+        interpret=interpret,
+    )
+    # V's per-channel scale applies to the normalised accumulator.
+    out = (
+        out.astype(jnp.float32).reshape(B, Hkv, G * Tq, D) * v_scale
+    ).reshape(B, Hq, Tq, D).astype(q.dtype)
+    return out, lse
 
 
 @functools.partial(
