@@ -195,6 +195,16 @@ class TestCLI:
         assert "host data pipeline" in logs
         assert len(record["losses"]) == 2 and all(l > 0 for l in record["losses"])
 
+    def test_decode_kv_quant_int8(self):
+        record, _ = run_cli(
+            "--device", "cpu", "--seq-len", "384", "--heads", "4",
+            "--head-dim", "32", "--dtype", "bfloat16", "--kv-quant", "int8",
+            "--iters", "2", "--warmup", "1", timeout=300,
+        )
+        assert record["name"] == "decode_q8"
+        assert record["workload"]["kv_quant"] == "int8"
+        assert record["tokens_per_sec"] > 0
+
     def test_train_corpus_data(self, tmp_path):
         import numpy as np
 

@@ -127,6 +127,45 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
         q_len=cfg.q_len, seq_len=cfg.seq_len, head_dim=cfg.head_dim,
         dtype=dtype,
     )
+    if cfg.kv_quant == "int8":
+        if mesh is not None:
+            raise ValueError(
+                "--kv-quant int8 is single-device decode only (quantize per "
+                "shard before a sharded merge instead)"
+            )
+        if cfg.impl not in ("auto", "pallas_decode"):
+            raise ValueError(
+                f"--kv-quant int8 runs the pallas_decode q8 kernel; "
+                f"--impl {cfg.impl} cannot serve a quantized buffer"
+            )
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8,
+            quantize_kv_channelwise,
+        )
+
+        q, k, v = make_qkv(key, **kw)
+        k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+        bk = cfg.block_size
+        fn = jax.jit(lambda q, k_q, v_q: attention_pallas_decode_q8(
+            q, k_q, v_q, k_s, v_s, causal=cfg.causal,
+            **({} if bk is None else {"block_size": bk}),
+        )[0])
+        stats = time_fn(fn, q, k_q, v_q, iters=cfg.iters, warmup=cfg.warmup)
+        flops = attention_flops(
+            batch=cfg.batch, heads=cfg.heads, q_len=cfg.q_len,
+            kv_len=cfg.seq_len, head_dim=cfg.head_dim, causal=cfg.causal,
+        )
+        workload = _workload(cfg, mesh=None, kv_quant="int8")
+        workload["impl"] = "pallas_decode"  # what actually ran
+        return BenchResult(
+            name="decode_q8",
+            workload=workload,
+            timing=stats,
+            tokens_per_sec=cfg.seq_len / stats.median,
+            flops_per_sec=flops / stats.median,
+            n_devices=1,
+            peak_hbm_bytes=_peak_hbm(),
+        )
     if mesh is None:
         q, k, v = make_qkv(key, **kw)
         fn = jax.jit(lambda q, k, v: flash_attention(
@@ -257,5 +296,10 @@ def run_bench(cfg: RunConfig, mesh: Optional[Mesh] = None) -> Dict[str, Any]:
     if cfg.comparator == "ring":
         if mesh is None:
             raise ValueError("the ring comparator needs a mesh (--mesh seq=N)")
+        if cfg.kv_quant != "none":
+            raise ValueError(
+                "--kv-quant does not apply to the tree-vs-ring comparator "
+                "(both sides run the exact training-shape path)"
+            )
         return bench_compare(cfg, mesh)
     return bench_decode(cfg, mesh).as_dict()
