@@ -205,6 +205,16 @@ class TestCLI:
         assert record["workload"]["kv_quant"] == "int8"
         assert record["tokens_per_sec"] > 0
 
+    def test_decode_kv_quant_int8_sharded(self):
+        record, _ = run_cli(
+            "--device", "cpu", "--seq-len", "384", "--heads", "4",
+            "--head-dim", "32", "--dtype", "bfloat16", "--kv-quant", "int8",
+            "--n-virtual-cpu", "4", "--mesh", "seq=4", "--block-size", "64",
+            "--iters", "2", "--warmup", "1", timeout=300,
+        )
+        assert record["name"] == "tree_decode_q8"
+        assert record["n_devices"] == 4
+
     def test_train_corpus_data(self, tmp_path):
         import numpy as np
 

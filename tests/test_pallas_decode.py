@@ -248,3 +248,30 @@ class TestQuantizedDecode:
             attention_pallas_decode_q8(q, k, v, k_s, v_s)  # not int8
         with pytest.raises(ValueError):
             attention_pallas_decode_q8(q, k_q, v_q, k_s[:, :, :, :1], v_s)
+
+    def test_tree_decode_q8_sharded_matches_unsharded(self):
+        """Sequence-parallel q8 decode: the dequantized-lse contract makes
+        the sharded merge equal the single-device q8 result."""
+        from tree_attention_tpu.parallel import cpu_mesh, tree_decode_q8
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8,
+            quantize_kv_channelwise,
+        )
+
+        rng = np.random.default_rng(4)
+        q, k, v = self._case(rng, Hq=4, Hkv=2, Tk=512, D=32)
+        k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+        mesh = cpu_mesh(4)
+        out_s, lse_s = tree_decode_q8(
+            q, k_q, v_q, k_s, v_s, mesh=mesh, block_size=64
+        )
+        out_u, lse_u = attention_pallas_decode_q8(
+            q, k_q, v_q, k_s, v_s, block_size=64
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_s, np.float32), np.asarray(out_u, np.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse_s), np.asarray(lse_u), atol=1e-2, rtol=1e-2
+        )

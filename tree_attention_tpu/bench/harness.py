@@ -27,7 +27,11 @@ from tree_attention_tpu.data import make_qkv, make_qkv_sharded
 from tree_attention_tpu.ops import flash_attention
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ, prune_axes
 from tree_attention_tpu.parallel.ring import ring_attention
-from tree_attention_tpu.parallel.tree import tree_attention, tree_decode
+from tree_attention_tpu.parallel.tree import (
+    tree_attention,
+    tree_decode,
+    tree_decode_q8,
+)
 from tree_attention_tpu.utils.config import RunConfig
 from tree_attention_tpu.utils.logging import get_logger
 from tree_attention_tpu.utils.profiling import TimingStats, device_memory_stats, time_fn
@@ -127,73 +131,79 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
         q_len=cfg.q_len, seq_len=cfg.seq_len, head_dim=cfg.head_dim,
         dtype=dtype,
     )
-    if cfg.kv_quant == "int8":
-        if mesh is not None:
-            raise ValueError(
-                "--kv-quant int8 is single-device decode only (quantize per "
-                "shard before a sharded merge instead)"
-            )
-        if cfg.impl not in ("auto", "pallas_decode"):
-            raise ValueError(
-                f"--kv-quant int8 runs the pallas_decode q8 kernel; "
-                f"--impl {cfg.impl} cannot serve a quantized buffer"
-            )
-        from tree_attention_tpu.ops.pallas_decode import (
-            attention_pallas_decode_q8,
-            quantize_kv_channelwise,
+    quant = cfg.kv_quant == "int8"
+    if quant and cfg.impl not in ("auto", "pallas_decode"):
+        raise ValueError(
+            f"--kv-quant int8 runs the pallas_decode q8 kernel; "
+            f"--impl {cfg.impl} cannot serve a quantized buffer"
         )
 
-        q, k, v = make_qkv(key, **kw)
-        k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
-        bk = cfg.block_size
-        fn = jax.jit(lambda q, k_q, v_q: attention_pallas_decode_q8(
-            q, k_q, v_q, k_s, v_s, causal=cfg.causal,
-            **({} if bk is None else {"block_size": bk}),
-        )[0])
-        stats = time_fn(fn, q, k_q, v_q, iters=cfg.iters, warmup=cfg.warmup)
-        flops = attention_flops(
-            batch=cfg.batch, heads=cfg.heads, q_len=cfg.q_len,
-            kv_len=cfg.seq_len, head_dim=cfg.head_dim, causal=cfg.causal,
-        )
-        workload = _workload(cfg, mesh=None, kv_quant="int8")
-        workload["impl"] = "pallas_decode"  # what actually ran
-        return BenchResult(
-            name="decode_q8",
-            workload=workload,
-            timing=stats,
-            tokens_per_sec=cfg.seq_len / stats.median,
-            flops_per_sec=flops / stats.median,
-            n_devices=1,
-            peak_hbm_bytes=_peak_hbm(),
-        )
+    # One flow for exact and quantized: generate, (optionally) quantize,
+    # pick the per-topology step fn and record name, then a single
+    # timing/record tail.
     if mesh is None:
         q, k, v = make_qkv(key, **kw)
-        fn = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=cfg.causal, impl=cfg.impl,
-            block_size=cfg.block_size,
-        )[0])
         n_devices = 1
     else:
         q, k, v = make_qkv_sharded(key, mesh, **kw)
         axes = prune_axes(mesh, {"data": "data", "model": "model"})
+        n_devices = mesh.size
 
-        def _decode(q, k, v):
-            return tree_decode(
-                q, k, v, mesh=mesh, causal=cfg.causal, impl=cfg.impl,
+    extra = {}
+    if quant:
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8,
+            quantize_kv_channelwise,
+        )
+        from tree_attention_tpu.ops.tuning import decode_block_k
+
+        # Per-channel scales are shard-invariant, so global quantization
+        # shards as-is (jnp ops run distributed on sharded inputs).
+        k, v, k_s, v_s = quantize_kv_channelwise(k, v)
+        extra = {"kv_quant": "int8"}
+        if mesh is None:
+            bk = (
+                decode_block_k(cfg.seq_len) if cfg.block_size is None
+                else cfg.block_size
+            )
+            name = "decode_q8"
+            fn = jax.jit(lambda q, k, v: attention_pallas_decode_q8(
+                q, k, v, k_s, v_s, causal=cfg.causal, block_size=bk,
+            )[0])
+        else:
+            name = "tree_decode_q8"
+            fn = jax.jit(lambda q, k, v: tree_decode_q8(
+                q, k, v, k_s, v_s, mesh=mesh, causal=cfg.causal,
                 block_size=cfg.block_size,
                 data_axis=axes["data"], head_axis=axes["model"],
-            )[0]
+            )[0])
+    elif mesh is None:
+        name = "decode"
+        fn = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=cfg.causal, impl=cfg.impl,
+            block_size=cfg.block_size,
+        )[0])
+    else:
+        name = "tree_decode"
+        fn = jax.jit(lambda q, k, v: tree_decode(
+            q, k, v, mesh=mesh, causal=cfg.causal, impl=cfg.impl,
+            block_size=cfg.block_size,
+            data_axis=axes["data"], head_axis=axes["model"],
+        )[0])
 
-        fn = jax.jit(_decode)
-        n_devices = mesh.size
     stats = time_fn(fn, q, k, v, iters=cfg.iters, warmup=cfg.warmup)
     flops = attention_flops(
         batch=cfg.batch, heads=cfg.heads, q_len=cfg.q_len, kv_len=cfg.seq_len,
         head_dim=cfg.head_dim, causal=cfg.causal,
     )
+    workload = _workload(
+        cfg, mesh=None if mesh is None else dict(mesh.shape), **extra
+    )
+    if quant:
+        workload["impl"] = "pallas_decode"  # what actually ran
     return BenchResult(
-        name="decode" if mesh is None else "tree_decode",
-        workload=_workload(cfg, mesh=None if mesh is None else dict(mesh.shape)),
+        name=name,
+        workload=workload,
         timing=stats,
         tokens_per_sec=cfg.seq_len / stats.median,  # KV tokens scanned per step
         flops_per_sec=flops / stats.median,

@@ -55,6 +55,16 @@ def _on_tpu(q=None) -> bool:
         return False
 
 
+def mesh_platforms(mesh):
+    """The set of device platforms a mesh spans, or None when the mesh has
+    no concrete devices to probe (e.g. an AbstractMesh) — callers should
+    then trust the compiled path rather than pessimise."""
+    try:
+        return {d.platform for d in mesh.devices.flat}
+    except Exception:
+        return None
+
+
 def resolve_impl_for_mesh(impl: str, mesh) -> str:
     """Pin ``impl='auto'`` for computations running on ``mesh``'s devices.
 
@@ -68,9 +78,8 @@ def resolve_impl_for_mesh(impl: str, mesh) -> str:
     """
     if impl != "auto":
         return impl
-    try:
-        platforms = {d.platform for d in mesh.devices.flat}
-    except Exception:
+    platforms = mesh_platforms(mesh)
+    if platforms is None:
         return impl
     if platforms == {"tpu"}:
         return impl

@@ -16,6 +16,7 @@ from tree_attention_tpu.parallel.tree import (  # noqa: F401
     shard_zigzag,
     tree_attention,
     tree_decode,
+    tree_decode_q8,
     unshard_zigzag,
     zigzag_perm,
 )

@@ -157,6 +157,65 @@ def _finalize_merge(num, den, m, out_dtype):
     return out.astype(out_dtype), lse.astype(jnp.float32)
 
 
+def _tree_decode_common(
+    q: jax.Array,
+    kv_arrays: Tuple[jax.Array, ...],
+    rep_arrays: Tuple[jax.Array, ...],
+    local_attn,
+    *,
+    mesh: Mesh,
+    seq_axis: str,
+    data_axis: Optional[str],
+    head_axis: Optional[str],
+    q_position: Optional[int],
+) -> Tuple[jax.Array, jax.Array]:
+    """Shared replicated-Q decode skeleton: validation, specs, shard_map,
+    safe-softmax merge. ``kv_arrays`` are sharded along dim 2 over
+    ``seq_axis``; ``rep_arrays`` are replicated across it.
+    ``local_attn(q_l, kv_locals, rep_locals, q_position, kv_offset)`` returns
+    the per-shard ``(out, lse)`` — the one thing the exact and quantized
+    paths differ in.
+    """
+    Tk_global = kv_arrays[0].shape[2]
+    Tq = q.shape[2]
+    if q_position is None:
+        q_position = Tk_global - Tq
+    n_shards = mesh.shape[seq_axis]
+    if Tk_global % n_shards:
+        raise ValueError(
+            f"global KV length {Tk_global} must divide over {n_shards} "
+            f"'{seq_axis}' shards"
+        )
+    Tk_local = Tk_global // n_shards
+
+    q_spec = P(data_axis, head_axis, None, None)
+    kv_spec = P(data_axis, head_axis, seq_axis, None)
+    rep_spec = P(data_axis, head_axis, None, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            (q_spec,)
+            + (kv_spec,) * len(kv_arrays)
+            + (rep_spec,) * len(rep_arrays)
+        ),
+        out_specs=(q_spec, P(data_axis, head_axis, None)),
+        check_vma=False,
+    )
+    def _sharded(q_l, *rest):
+        kv_locals = rest[: len(kv_arrays)]
+        rep_locals = rest[len(kv_arrays):]
+        shard = lax.axis_index(seq_axis)
+        out, lse = local_attn(
+            q_l, kv_locals, rep_locals, q_position, shard * Tk_local
+        )
+        num, den, m = _merge_across(out, lse, seq_axis)
+        return _finalize_merge(num, den, m, q.dtype)
+
+    return _sharded(q, *kv_arrays, *rep_arrays)
+
+
 def tree_decode(
     q: jax.Array,
     k: jax.Array,
@@ -184,42 +243,86 @@ def tree_decode(
     Returns:
       ``(out, lse)`` with q's sharding (replicated over ``seq_axis``).
     """
-    Tk_global = k.shape[2]
-    Tq = q.shape[2]
-    if q_position is None:
-        q_position = Tk_global - Tq
-    n_shards = mesh.shape[seq_axis]
-    if Tk_global % n_shards:
-        raise ValueError(
-            f"global KV length {Tk_global} must divide over {n_shards} "
-            f"'{seq_axis}' shards"
-        )
-    Tk_local = Tk_global // n_shards
     impl = resolve_impl_for_mesh(impl, mesh)
 
-    q_spec = P(data_axis, head_axis, None, None)
-    kv_spec = P(data_axis, head_axis, seq_axis, None)
-
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec),
-        out_specs=(q_spec, P(data_axis, head_axis, None)),
-        check_vma=False,
-    )
-    def _sharded(q_l, k_l, v_l):
-        shard = lax.axis_index(seq_axis)
-        out, lse = flash_attention(
+    def local_attn(q_l, kv_locals, _rep, q_pos, kv_off):
+        k_l, v_l = kv_locals
+        return flash_attention(
             q_l, k_l, v_l,
             causal=causal, scale=scale,
-            q_offset=q_position,
-            kv_offset=shard * Tk_local,
+            q_offset=q_pos, kv_offset=kv_off,
             impl=impl, block_size=block_size,
         )
-        num, den, m = _merge_across(out, lse, seq_axis)
-        return _finalize_merge(num, den, m, q.dtype)
 
-    return _sharded(q, k, v)
+    return _tree_decode_common(
+        q, (k, v), (), local_attn,
+        mesh=mesh, seq_axis=seq_axis, data_axis=data_axis,
+        head_axis=head_axis, q_position=q_position,
+    )
+
+
+def tree_decode_q8(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_position: Optional[int] = None,
+    block_size: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`tree_decode` over an int8-quantized KV buffer.
+
+    Same sharding contract as :func:`tree_decode` (Q replicated over
+    ``seq_axis``; ``k_q``/``v_q`` int8, sharded along dim 2) with the
+    per-channel scales ``(B, Hkv, 1, D)`` replicated across shards — scales
+    are per channel, not per token, so a sequence shard changes nothing
+    about them. Each device runs the q8 flash-decode kernel
+    (:func:`tree_attention_tpu.ops.pallas_decode.attention_pallas_decode_q8`)
+    over its shard; the lse it emits is of the *dequantized* logits, so the
+    partials merge through exactly the same safe-softmax collective as the
+    exact path. Halves the per-device KV stream — the decode step's entire
+    cost — while the collective payload is unchanged.
+    """
+    from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode_q8
+    from tree_attention_tpu.ops.tuning import decode_block_k
+
+    n_shards = mesh.shape[seq_axis]
+    Tk_local = k_q.shape[2] // max(n_shards, 1)
+    bk = decode_block_k(max(Tk_local, 1)) if block_size is None else block_size
+    # Inside shard_map the arrays are tracers, so the kernel's own
+    # interpret auto-detection would consult the default backend — wrong
+    # when the mesh lives on a different platform (an emulated CPU mesh on
+    # a TPU-default host). Resolve from the mesh, like
+    # resolve_impl_for_mesh does for the exact path; an unprobeable mesh
+    # (None) trusts the compiled path rather than pessimising to the
+    # interpreter.
+    from tree_attention_tpu.ops import mesh_platforms
+
+    platforms = mesh_platforms(mesh)
+    interpret = None if platforms is None or platforms == {"tpu"} else True
+
+    def local_attn(q_l, kv_locals, rep_locals, q_pos, kv_off):
+        k_l, v_l = kv_locals
+        ks_l, vs_l = rep_locals
+        return attention_pallas_decode_q8(
+            q_l, k_l, v_l, ks_l, vs_l,
+            causal=causal, scale=scale,
+            q_offset=q_pos, kv_offset=kv_off,
+            block_size=bk, interpret=interpret,
+        )
+
+    return _tree_decode_common(
+        q, (k_q, v_q), (k_scale, v_scale), local_attn,
+        mesh=mesh, seq_axis=seq_axis, data_axis=data_axis,
+        head_axis=head_axis, q_position=q_position,
+    )
 
 
 def tree_attention(
