@@ -199,3 +199,88 @@ def test_flash_decode_tpu_branch_interpret(monkeypatch, tq):
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=3e-5, rtol=3e-5)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=3e-5, rtol=3e-5)
+
+
+# ---------------------------------------------------------------------------
+# Quantized cache (quantize-after-prefill)
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_cache_roundtrip():
+    from tree_attention_tpu.models import init_cache, quantize_cache
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 24), 0, CFG.vocab_size)
+    cache = init_cache(CFG, 1, 32)
+    _, cache = forward_step(params, tokens, cache, CFG)
+    qc = quantize_cache(cache)
+    assert qc.k.dtype == jnp.int8 and qc.v.dtype == jnp.int8
+    assert int(qc.length) == 24
+    k_dq = qc.k.astype(np.float32) * np.asarray(qc.k_scale)
+    err = np.abs(k_dq[:, :, :, :24] - np.asarray(cache.k, np.float32)[:, :, :, :24])
+    # int8 per-channel: error bounded by scale/2 = amax/254 per channel.
+    bound = np.abs(np.asarray(cache.k, np.float32)).max() / 200.0
+    assert float(err.max()) <= bound, (float(err.max()), bound)
+
+
+def test_quantized_incremental_decode_tracks_exact():
+    """Prefill exactly, quantize, decode the rest step-by-step: logits stay
+    close to the exact incremental path (int8 error, not divergence)."""
+    from tree_attention_tpu.models import quantize_cache
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, CFG.vocab_size)
+    Tp = 16
+
+    def run(quant):
+        cache = init_cache(CFG, 1, 32)
+        logits, cache = forward_step(params, tokens[:, :Tp], cache, CFG)
+        if quant:
+            cache = quantize_cache(cache)
+        outs = [logits]
+        for t in range(Tp, 32):
+            logits, cache = forward_step(params, tokens[:, t:t + 1], cache, CFG)
+            outs.append(logits)
+        return np.concatenate([np.asarray(o) for o in outs], axis=1)
+
+    exact = run(False)
+    quant = run(True)
+    err = np.abs(exact - quant).max()
+    assert err < 0.5, err  # small vs logit scale (~10); zero would mean no quant
+    assert err > 0.0
+
+
+def test_generate_quantize_after_prefill_runs_and_matches_greedy_mostly():
+    from tree_attention_tpu.models import generate
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 12), 0, CFG.vocab_size)
+    toks_q = generate(
+        params, prompt, 8, CFG, quantize_after_prefill=True
+    )
+    assert toks_q.shape == (1, 8)
+    assert np.all((np.asarray(toks_q) >= 0) & (np.asarray(toks_q) < CFG.vocab_size))
+
+
+def test_quantized_decode_sharded_matches_unsharded():
+    """QuantKVCache over a 4-way seq mesh: tree_decode_q8 merge == one device."""
+    from tree_attention_tpu.models import quantize_cache
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 24), 0, CFG.vocab_size)
+    mesh = cpu_mesh(4)
+
+    def run(mesh_arg, cache_len=32):
+        kw = {} if mesh_arg is None else {"mesh": mesh_arg}
+        cache = init_cache(CFG, 1, cache_len, **kw)
+        logits, cache = forward_step(params, tokens[:, :16], cache, CFG, **kw)
+        cache = quantize_cache(cache)
+        outs = []
+        for t in range(16, 24):
+            logits, cache = forward_step(params, tokens[:, t:t + 1], cache, CFG, **kw)
+            outs.append(np.asarray(logits))
+        return np.concatenate(outs, axis=1)
+
+    np.testing.assert_allclose(
+        run(None), run(mesh), atol=5e-3, rtol=5e-3
+    )

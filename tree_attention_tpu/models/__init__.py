@@ -17,10 +17,13 @@ from tree_attention_tpu.models.transformer import (  # noqa: F401
 )
 from tree_attention_tpu.models.decode import (  # noqa: F401
     KVCache,
+    QuantKVCache,
     decode_attention,
+    decode_attention_q8,
     forward_step,
     generate,
     init_cache,
+    quantize_cache,
 )
 from tree_attention_tpu.models.train import (  # noqa: F401
     default_optimizer,

@@ -69,6 +69,56 @@ class KVCache:
         return self.k.shape[3]
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantKVCache:
+    """int8 per-layer KV buffers with frozen per-channel scales.
+
+    The quantize-after-prefill shape: the prompt is prefilled in the model
+    dtype, :func:`quantize_cache` converts the filled buffers once (scales =
+    per-channel absmax of the prefix), and subsequent decode steps append
+    new rows quantized under those *frozen* scales (outliers clamp to
+    ±127). Halves the KV bytes the decode step streams — the step's entire
+    cost at long context — at int8 quantization error. The exact
+    :class:`KVCache` stays the default.
+    """
+
+    k: jax.Array        # (L, B, Hkv, Tmax, D) int8
+    v: jax.Array        # (L, B, Hkv, Tmax, D) int8
+    k_scale: jax.Array  # (L, B, Hkv, 1, D) float32
+    v_scale: jax.Array  # (L, B, Hkv, 1, D) float32
+    length: jax.Array   # () int32
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[3]
+
+
+def quantize_cache(cache: KVCache) -> QuantKVCache:
+    """Per-channel int8 quantization of a (typically just-prefilled) cache.
+
+    Scales come from the filled prefix only — unwritten capacity rows are
+    zeros and must not shrink the scale; rows appended later clamp to the
+    prefix's range (attention values live in the prompt's activation
+    distribution, so the clamp is rare in practice).
+    """
+
+    from tree_attention_tpu.ops.pallas_decode import quantize_symmetric_int8
+
+    k_q, k_s = quantize_symmetric_int8(cache.k, axis=3)  # over tokens
+    v_q, v_s = quantize_symmetric_int8(cache.v, axis=3)
+    return QuantKVCache(
+        k=k_q, v=v_q, k_scale=k_s, v_scale=v_s, length=cache.length
+    )
+
+
+def _quantize_rows(rows: jax.Array, scale: jax.Array) -> jax.Array:
+    """Quantize new (B, Hkv, Tq, D) rows under one layer's frozen scale."""
+    return jnp.clip(
+        jnp.round(rows.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+
+
 def init_cache(
     cfg: TransformerConfig,
     batch_size: int,
@@ -124,7 +174,10 @@ def forward_step(
 
     Returns:
       ``logits``: ``(B, Tq, vocab)`` float32; the updated cache
-      (``length += Tq``).
+      (``length += Tq``). With a :class:`QuantKVCache`, new rows quantize
+      under the cache's frozen scales and attention runs the q8 kernels —
+      ``cfg.attn_impl`` and ``num_splits`` apply to the exact cache only
+      (the q8 path has exactly one kernel, split-KV internally).
     """
     axes = prune_axes(
         mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
@@ -144,9 +197,13 @@ def forward_step(
     positions = start + jnp.arange(Tq, dtype=jnp.int32)
 
     x = jnp.take(params["embed"], tokens, axis=0)
+    quant = isinstance(cache, QuantKVCache)
 
     def body(x, layer_and_cache):
-        layer, k_cache, v_cache = layer_and_cache
+        if quant:
+            layer, k_cache, v_cache, k_s, v_s = layer_and_cache
+        else:
+            layer, k_cache, v_cache = layer_and_cache
         h = rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = _heads(h @ layer["wq"], cfg.n_heads, cfg.d_head)
         k_new = _heads(h @ layer["wk"], cfg.n_kv_heads, cfg.d_head)
@@ -156,6 +213,10 @@ def forward_step(
 
         # Write the new rows at [start, start+Tq). Under a mesh GSPMD turns
         # the dynamic-update into per-shard masked writes on the seq dim.
+        # Quantized caches quantize the rows under the frozen scales first.
+        if quant:
+            k_new = _quantize_rows(k_new, k_s)
+            v_new = _quantize_rows(v_new, v_s)
         k_cache = lax.dynamic_update_slice_in_dim(
             k_cache, k_new.astype(k_cache.dtype), start, axis=2
         )
@@ -163,27 +224,41 @@ def forward_step(
             v_cache, v_new.astype(v_cache.dtype), start, axis=2
         )
 
-        out, _ = decode_attention(
-            q, k_cache, v_cache,
+        attn_kw = dict(
             q_position=start,
             mesh=mesh,
             data_axis=axes["data"],
             seq_axis=axes["seq"],
             model_axis=axes["model"],
-            impl=cfg.attn_impl,
-            num_splits=num_splits,
             block_size=cfg.attn_block_size,
         )
+        if quant:
+            out, _ = decode_attention_q8(
+                q, k_cache, v_cache, k_s, v_s, **attn_kw
+            )
+        else:
+            out, _ = decode_attention(
+                q, k_cache, v_cache,
+                impl=cfg.attn_impl, num_splits=num_splits, **attn_kw,
+            )
         x = x + _unheads(out) @ layer["wo"]
         x = x + _mlp_block(layer, rms_norm(x, layer["ln2"], cfg.norm_eps))
         return x, (k_cache, v_cache)
 
-    x, (new_k, new_v) = lax.scan(
-        body, x, (params["layers"], cache.k, cache.v)
-    )
+    xs = (params["layers"], cache.k, cache.v)
+    if quant:
+        xs = xs + (cache.k_scale, cache.v_scale)
+    x, (new_k, new_v) = lax.scan(body, x, xs)
     x = rms_norm(x, params["ln_f"], cfg.norm_eps)
     logits = (x @ params["wout"]).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=start + Tq)
+    if quant:
+        new_cache = QuantKVCache(
+            k=new_k, v=new_v, k_scale=cache.k_scale, v_scale=cache.v_scale,
+            length=start + Tq,
+        )
+    else:
+        new_cache = KVCache(k=new_k, v=new_v, length=start + Tq)
+    return logits, new_cache
 
 
 def _sample(logits: jax.Array, temperature: float, key: Optional[jax.Array]):
@@ -208,6 +283,7 @@ def generate(
     data_axis: Optional[str] = AXIS_DATA,
     seq_axis: str = AXIS_SEQ,
     model_axis: Optional[str] = AXIS_MODEL,
+    quantize_after_prefill: bool = False,
 ) -> jax.Array:
     """Prefill the prompt, then decode ``max_new_tokens`` autoregressively.
 
@@ -215,6 +291,9 @@ def generate(
       prompt: ``(B, Tp)`` token ids.
       cache_len: cache capacity; defaults to ``Tp + max_new_tokens`` rounded up
         to the mesh's seq-shard multiple.
+      quantize_after_prefill: prefill exactly, then int8-quantize the cache
+        (:func:`quantize_cache`) so every decode step streams half the KV
+        bytes. Approximate (per-channel int8); default off.
 
     Returns:
       ``(B, max_new_tokens)`` sampled token ids.
@@ -237,6 +316,8 @@ def generate(
     )
     cache = init_cache(cfg, B, cache_len, **kw)
     logits, cache = forward_step(params, prompt, cache, cfg, **kw)
+    if quantize_after_prefill:
+        cache = quantize_cache(cache)
     key, sub = jax.random.split(key)
     tok = _sample(logits[:, -1], temperature, sub)
 
@@ -258,6 +339,8 @@ def decode_attention(
     k: jax.Array,
     v: jax.Array,
     *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
     q_position=None,
     mesh: Optional[Mesh] = None,
     data_axis: Optional[str] = AXIS_DATA,
@@ -271,26 +354,63 @@ def decode_attention(
 
     The two are the same algorithm at different granularity (chunks vs
     shards); this picks by topology so callers write one line. This is the
-    single home of that dispatch rule — :func:`forward_step` routes through it.
+    single home of that dispatch rule — :func:`forward_step` routes through
+    it for both the exact and the quantized cache. Passing ``k_scale`` /
+    ``v_scale`` (with int8 ``k``/``v``) selects the q8 kernels; ``impl`` and
+    ``num_splits`` apply to the exact path only (the q8 path has exactly one
+    kernel, which is split-KV internally).
     """
+    quant = k_scale is not None
+    if quant and v_scale is None or (not quant and v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if q_position is None:
+        q_position = k.shape[2] - q.shape[2]
     ax = prune_axes(
         mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
     )
     if mesh is not None and mesh.shape.get(ax["seq"] or "", 1) > 1:
-        from tree_attention_tpu.parallel.tree import tree_decode
-
-        return tree_decode(
-            q, k, v,
+        mesh_kw = dict(
             mesh=mesh,
             seq_axis=ax["seq"],
             data_axis=ax["data"],
             head_axis=ax["model"],
             causal=True,
             q_position=q_position,
-            impl=impl,
             block_size=block_size,
+        )
+        if quant:
+            from tree_attention_tpu.parallel.tree import tree_decode_q8
+
+            return tree_decode_q8(q, k, v, k_scale, v_scale, **mesh_kw)
+        from tree_attention_tpu.parallel.tree import tree_decode
+
+        return tree_decode(q, k, v, impl=impl, **mesh_kw)
+    if quant:
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8,
+        )
+        from tree_attention_tpu.ops.tuning import decode_block_k
+
+        bk = decode_block_k(k.shape[2]) if block_size is None else block_size
+        return attention_pallas_decode_q8(
+            q, k, v, k_scale, v_scale, causal=True,
+            q_offset=q_position, block_size=bk,
         )
     return flash_decode(
         q, k, v, q_position=q_position, num_splits=num_splits,
         block_size=block_size,
+    )
+
+
+def decode_attention_q8(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    **kw,
+) -> Tuple[jax.Array, jax.Array]:
+    """Quantized decode: sugar for :func:`decode_attention` with scales."""
+    return decode_attention(
+        q, k_q, v_q, k_scale=k_scale, v_scale=v_scale, **kw
     )

@@ -182,17 +182,22 @@ def quantize_kv_channelwise(
     and V's applies to the accumulator in the epilogue — both O(D) per
     step, not O(T·D).
     """
-    @jax.jit
-    def q8(x):
-        xf = x.astype(jnp.float32)
-        amax = jnp.max(jnp.abs(xf), axis=2, keepdims=True)
-        scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
-        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
-        return q, scale
-
-    k_q, k_s = q8(k)
-    v_q, v_s = q8(v)
+    k_q, k_s = quantize_symmetric_int8(k, axis=2)
+    v_q, v_s = quantize_symmetric_int8(v, axis=2)
     return k_q, v_q, k_s, v_s
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def quantize_symmetric_int8(x: jax.Array, axis: int):
+    """The one definition of the q8 numeric contract the kernels dequant
+    against: absmax/127 scale (zero-channel scale = 1.0), f32 intermediate,
+    round, clip to ±127, int8. ``axis`` is the reduction (token) axis —
+    2 for a (B, Hkv, T, D) buffer, 3 for a (L, B, Hkv, T, D) cache."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 @functools.partial(
