@@ -215,6 +215,16 @@ class TestCLI:
         assert record["name"] == "tree_decode_q8"
         assert record["n_devices"] == 4
 
+    def test_generate_kv_quant_int8(self):
+        record, _ = run_cli(
+            "--mode", "generate", "--device", "cpu", "--seq-len", "16",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--q-len", "4", "--dtype", "float32",
+            "--max-new-tokens", "6", "--kv-quant", "int8", timeout=300,
+        )
+        assert record["kv_quant"] == "int8"
+        assert len(record["tokens"][0]) == 6
+
     def test_train_corpus_data(self, tmp_path):
         import numpy as np
 

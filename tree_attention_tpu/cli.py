@@ -338,6 +338,12 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
         raise SystemExit("--temperature must be >= 0 (0 = greedy)")
     if cfg.max_new_tokens < 1:
         raise SystemExit("--max-new-tokens must be >= 1")
+    if cfg.kv_quant == "int8" and cfg.impl not in ("auto", "pallas_decode"):
+        # Same rejection the bench surface gives this flag pair.
+        raise SystemExit(
+            f"--kv-quant int8 runs the pallas_decode q8 kernel; "
+            f"--impl {cfg.impl} cannot serve a quantized buffer"
+        )
     tcfg = _transformer_config(cfg)
     params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
     prompt = jax.random.randint(
@@ -349,10 +355,19 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
         params, prompt, n_new, tcfg,
         temperature=cfg.temperature, key=jax.random.PRNGKey(cfg.seed + 2),
         mesh=mesh,
+        quantize_after_prefill=cfg.kv_quant == "int8",
     )
     toks = jax.block_until_ready(toks)
-    log.info("generated %s tokens from a %s prompt", toks.shape, prompt.shape)
-    _emit({"mode": "generate", "tokens": toks.tolist()})
+    log.info(
+        "generated %s tokens from a %s prompt%s",
+        toks.shape, prompt.shape,
+        " (int8 KV cache)" if cfg.kv_quant == "int8" else "",
+    )
+    _emit({
+        "mode": "generate",
+        "tokens": toks.tolist(),
+        **({"kv_quant": "int8"} if cfg.kv_quant == "int8" else {}),
+    })
     return 0
 
 

@@ -19,7 +19,6 @@ from tree_attention_tpu.models.decode import (  # noqa: F401
     KVCache,
     QuantKVCache,
     decode_attention,
-    decode_attention_q8,
     forward_step,
     generate,
     init_cache,

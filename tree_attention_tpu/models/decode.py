@@ -30,7 +30,7 @@ attention routes through :func:`tree_decode
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -156,7 +156,7 @@ def init_cache(
 def forward_step(
     params: Params,
     tokens: jax.Array,
-    cache: KVCache,
+    cache: Union[KVCache, QuantKVCache],
     cfg: TransformerConfig,
     *,
     mesh: Optional[Mesh] = None,
@@ -164,7 +164,7 @@ def forward_step(
     seq_axis: str = AXIS_SEQ,
     model_axis: Optional[str] = AXIS_MODEL,
     num_splits: Optional[int] = None,
-) -> Tuple[jax.Array, KVCache]:
+) -> Tuple[jax.Array, Union[KVCache, QuantKVCache]]:
     """Run ``Tq`` new tokens through the model against the cache.
 
     Args:
@@ -233,8 +233,8 @@ def forward_step(
             block_size=cfg.attn_block_size,
         )
         if quant:
-            out, _ = decode_attention_q8(
-                q, k_cache, v_cache, k_s, v_s, **attn_kw
+            out, _ = decode_attention(
+                q, k_cache, v_cache, k_scale=k_s, v_scale=v_s, **attn_kw
             )
         else:
             out, _ = decode_attention(
@@ -402,15 +402,4 @@ def decode_attention(
     )
 
 
-def decode_attention_q8(
-    q: jax.Array,
-    k_q: jax.Array,
-    v_q: jax.Array,
-    k_scale: jax.Array,
-    v_scale: jax.Array,
-    **kw,
-) -> Tuple[jax.Array, jax.Array]:
-    """Quantized decode: sugar for :func:`decode_attention` with scales."""
-    return decode_attention(
-        q, k_q, v_q, k_scale=k_scale, v_scale=v_scale, **kw
-    )
+

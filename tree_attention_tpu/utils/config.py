@@ -56,7 +56,7 @@ class RunConfig:
     launch_timeout: Optional[float] = None  # seconds; kill all ranks at expiry
     impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
     block_size: Optional[int] = None  # None -> impl-appropriate default
-    kv_quant: str = "none"  # none | int8 (decode mode: quantized KV buffer)
+    kv_quant: str = "none"  # none | int8 (decode/generate: quantized KV)
     seq_layout: str = "contiguous"  # contiguous | zigzag (train mode, seq>1)
     seed: int = 0
 
@@ -144,8 +144,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-size", type=int, default=d.block_size,
                    help="KV tile length (default: per-impl tuned value)")
     p.add_argument("--kv-quant", choices=["none", "int8"], default=d.kv_quant,
-                   help="decode mode: int8-quantize the KV buffer "
-                        "(per-channel scales; halves the KV stream)")
+                   help="decode: int8-quantize the KV buffer; generate: "
+                        "quantize the cache after prefill (per-channel "
+                        "scales; halves the KV stream)")
     p.add_argument("--seq-layout", choices=["contiguous", "zigzag"],
                    default=d.seq_layout,
                    help="train mode: sequence layout over the seq mesh axis "
