@@ -23,7 +23,7 @@ at global position ``length + i`` and the causal rule ``q_pos >= k_pos``
 already hides every cache slot ``>= length`` (they are the future). Cache
 attention routes through :func:`tree_decode
 <tree_attention_tpu.parallel.tree.tree_decode>` on a sequence-parallel mesh
-(replicated Q, one pmax + one packed psum) and through :func:`flash_decode
+(replicated Q, one pmax + one fused psum) and through :func:`flash_decode
 <tree_attention_tpu.ops.decode.flash_decode>` (split-KV) on a single device.
 """
 
