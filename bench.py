@@ -10,8 +10,10 @@ Workloads (VERDICT round-1 item 5 — one driver-parseable record):
 - ``decode_gqa_1m``   — 32 query / 4 KV heads, 1M-token context.
 - ``decode_mha_1m``   — 16 MHA heads, 1M-token context (the round-1
   transient-gate cliff case).
-- ``train_fwd_bwd``   — causal training-shape forward+backward through the
-  Pallas kernels, TFLOP/s.
+- ``train_fwd_bwd``   — causal training-shape forward and forward+backward
+  through the Pallas kernels at seq 4096: TFLOP/s and MFU vs the v5e bf16
+  peak, with FLOPs counted from the kernels' live-tile launches.
+- ``train_fwd_bwd_16k`` — the same at seq 16384 (BASELINE config 2's shape).
 - ``tree_vs_ring``    — tree- vs ring-attention step time on an emulated
   8-way sequence mesh (clean subprocess, CPU backend; the BASELINE.json
   north-star ratio's shape). Read it as a correctness/latency-shape check,
@@ -46,6 +48,7 @@ import subprocess
 import sys
 
 HBM_ROOFLINE = 819e9  # TPU v5e spec HBM bandwidth, bytes/s
+BF16_PEAK = 197e12  # TPU v5e spec bf16 peak, FLOP/s
 BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
 
 
@@ -67,8 +70,13 @@ def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
         def mk(n):
             def f(q, k, v):
                 def body(qc, _):
+                    # causal=True with the newest-token position: the exact
+                    # masking branch the product decode runs
+                    # (models/decode.py forward_step) — the headline times
+                    # the shipped code path, not a maskless variant
+                    # (VERDICT r2 weak item 6).
                     out, _lse = flash_attention(
-                        qc, k, v, causal=False, impl=impl,
+                        qc, k, v, causal=True, q_offset=T - 1, impl=impl,
                         block_size=block_size, custom_vjp=False,
                     )
                     return out.astype(qc.dtype), None
@@ -98,7 +106,8 @@ def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
     bw = kv_bytes / per_step
     rec = {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
-                     "head_dim": D, "dtype": "bfloat16", "q_len": 1},
+                     "head_dim": D, "dtype": "bfloat16", "q_len": 1,
+                     "causal": True},
         "impl": impl,
         "us_per_step": round(per_step * 1e6, 1),
         "kv_tokens_per_sec": round(T / per_step, 1),
@@ -135,7 +144,9 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large):
     def mk(n):
         def f(q, k_q, v_q):
             def body(qc, _):
-                out, _ = attention_pallas_decode_q8(qc, k_q, v_q, k_s, v_s)
+                out, _ = attention_pallas_decode_q8(
+                    qc, k_q, v_q, k_s, v_s, causal=True, q_offset=T - 1
+                )
                 return out.astype(qc.dtype), None
 
             return lax.scan(body, q, None, length=n)[0]
@@ -149,7 +160,8 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large):
     bw = kv_bytes / per_step
     return {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
-                     "head_dim": D, "kv_dtype": "int8", "q_len": 1},
+                     "head_dim": D, "kv_dtype": "int8", "q_len": 1,
+                     "causal": True},
         "us_per_step": round(per_step * 1e6, 1),
         "kv_tokens_per_sec": round(T / per_step, 1),
         "hbm_bytes_per_sec": round(bw, 1),
@@ -157,50 +169,100 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large):
     }
 
 
-def _train_record():
-    """Causal training-shape fwd+bwd TFLOP/s through the Pallas kernels."""
+def _live_tiles(Tq, Tk, bq, bk, q_off=0, kv_off=0, causal=True):
+    """Causally live (Q-tile, KV-tile) pairs at the kernels' launch geometry
+    — the same ``tile_live`` predicate the kernels gate compute on
+    (``ops/block_utils.py``), so FLOPs derive from what is actually
+    launched, not from a smooth T²/2 idealisation."""
+    import numpy as np
+
+    n_q, n_k = -(-Tq // bq), -(-Tk // bk)
+    if not causal:
+        return n_q * n_k
+    qi = np.arange(n_q)[:, None]
+    ki = np.arange(n_k)[None, :]
+    return int(((q_off + qi * bq + bq - 1) >= (kv_off + ki * bk)).sum())
+
+
+def _train_record(T=4096, n_small=8, n_large=32):
+    """Causal training-shape fwd and fwd+bwd through the Pallas kernels.
+
+    FLOPs are counted from the kernel launches (VERDICT r2 weak item 3):
+    per live tile pair the fwd kernel runs 2 matmul passes (s = q·kᵀ,
+    acc += p·v), the dQ kernel 3 (recompute s, dp = do·vᵀ, dq += ds·k) and
+    the dKV kernel 4 (recompute s, dp, dk += dsᵀ·q, dv += pᵀ·do) — each
+    pass 2·bq·bk·D FLOPs — so fwd+bwd is 4.5× fwd, not an assumed
+    multiplier. MFU is against the v5e bf16 peak.
+    """
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from tree_attention_tpu.ops import flash_attention
+    from tree_attention_tpu.ops.tuning import default_block_q, default_block_size
     from tree_attention_tpu.utils.profiling import time_per_step
 
-    B, H, T, D = 1, 16, 4096, 128
+    B, H, D = 1, 16, 128
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
     q = jax.random.normal(kq, (B, H, T, D), jnp.bfloat16)
     k = jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, H, T, D), jnp.bfloat16)
 
-    def make_chain(n):
-        def step(q_, k_, v_):
-            def loss(q__):
-                o, _ = flash_attention(q__, k_, v_, causal=True)
-                return jnp.sum(o.astype(jnp.float32) ** 2)
+    def chain(step):
+        def f(n):
+            def g(q_, k_, v_):
+                def body(qc, _):
+                    return step(qc, k_, v_).astype(qc.dtype), None
 
-            return jax.grad(loss)(q_)
+                return lax.scan(body, q_, None, length=n)[0]
 
-        def f(q_, k_, v_):
-            from jax import lax
+            return jax.jit(g)
 
-            def body(qc, _):
-                return step(qc, k_, v_).astype(qc.dtype), None
+        return f
 
-            return lax.scan(body, q_, None, length=n)[0]
+    def fwd_step(q_, k_, v_):
+        return flash_attention(q_, k_, v_, causal=True, custom_vjp=False)[0]
 
-        return jax.jit(f)
+    def bwd_step(q_, k_, v_):
+        def loss(q__, k__, v__):
+            o, _ = flash_attention(q__, k__, v__, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
 
-    per_step, _, _ = time_per_step(
-        make_chain, q, k, v, n_small=8, n_large=32, iters=5, warmup=1,
+        # Differentiate w.r.t. all three operands and fold every gradient
+        # into the carried value: training needs dk/dv too, and grad-wrt-q
+        # alone lets XLA dead-code-eliminate the dKV kernel — the timed
+        # work would then be ~5 of the 9 counted passes (verified via
+        # compiled cost_analysis).
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+        return dq + dk + dv
+
+    per_fwd, _, _ = time_per_step(
+        chain(fwd_step), q, k, v, n_small=n_small, n_large=n_large,
+        iters=5, warmup=1,
     )
-    # Causal fwd = 2·(T²/2)·D MACs × 2 matmuls; bwd ≈ 2.5× fwd (dq, dk, dv
-    # + recompute). FLOPs = 2 FLOP/MAC.
-    fwd_flops = 2 * 2 * B * H * (T * T / 2) * D
-    total_flops = fwd_flops * 3.5
+    per_both, _, _ = time_per_step(
+        chain(bwd_step), q, k, v, n_small=n_small, n_large=n_large,
+        iters=5, warmup=1,
+    )
+    bq = default_block_q(T, T)
+    bk = default_block_size("pallas", T)
+    pass_flops = 2 * bq * bk * D * B * H * _live_tiles(T, T, bq, bk)
+    fwd_flops = 2 * pass_flops
+    both_flops = 9 * pass_flops  # fwd 2 + dQ 3 + dKV 4
     return {
         "workload": {"batch": B, "heads": H, "seq_len": T, "head_dim": D,
-                     "causal": True, "dtype": "bfloat16"},
-        "us_per_step": round(per_step * 1e6, 1),
-        "tflops_per_sec": round(total_flops / per_step / 1e12, 1),
+                     "causal": True, "dtype": "bfloat16",
+                     "block_q": bq, "block_k": bk},
+        "fwd": {
+            "us_per_step": round(per_fwd * 1e6, 1),
+            "tflops_per_sec": round(fwd_flops / per_fwd / 1e12, 1),
+            "mfu_pct": round(fwd_flops / per_fwd / BF16_PEAK * 100, 1),
+        },
+        "fwd_bwd": {
+            "us_per_step": round(per_both * 1e6, 1),
+            "tflops_per_sec": round(both_flops / per_both / 1e12, 1),
+            "mfu_pct": round(both_flops / per_both / BF16_PEAK * 100, 1),
+        },
     }
 
 
@@ -246,7 +308,16 @@ def _tpu_reachable(timeout_s: int = 240):
     failure reason, letting the suite fall back to the CPU backend instead of
     hanging the driver's end-of-round bench run. Returns ``(ok, reason)`` —
     the reason distinguishes a tunnel timeout from e.g. a broken jax install.
+
+    ``TREE_ATTN_FORCE_CPU=1`` / ``TREE_ATTN_FORCE_TPU=1`` skip the probe
+    entirely: each timed-out probe is itself a killed tunnel client that can
+    extend a wedge, so repeated bench runs during a known wedge should not
+    keep re-probing (ADVICE r2).
     """
+    if os.environ.get("TREE_ATTN_FORCE_CPU") == "1":
+        return False, "probe skipped: TREE_ATTN_FORCE_CPU=1"
+    if os.environ.get("TREE_ATTN_FORCE_TPU") == "1":
+        return True, "probe skipped: TREE_ATTN_FORCE_TPU=1"
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -265,6 +336,88 @@ def _tpu_reachable(timeout_s: int = 240):
         return False, f"probe failed to launch: {e}"
 
 
+_EVIDENCE_PATH = os.environ.get(
+    "TREE_ATTN_EVIDENCE_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_evidence.jsonl"),
+)
+_TPU_RECORDS = ("decode_64k", "decode_gqa_128k", "decode_gqa_1m",
+                "decode_mha_1m", "decode_64k_q8", "train_fwd_bwd",
+                "train_fwd_bwd_16k")
+
+
+def _save_evidence(suite) -> None:
+    """Append this run's TPU records to the round-long evidence file.
+
+    Chip windows on the tunneled TPU are precious and can close mid-round
+    (the axon wedge); every successful TPU bench run therefore persists its
+    records, so a later run that finds the tunnel down can replay the
+    newest chip data instead of erasing a round's evidence (VERDICT r2
+    item 5 / weak item 1)."""
+    import time
+
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:
+        commit = ""
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
+    try:
+        with open(_EVIDENCE_PATH, "a") as f:
+            for name in _TPU_RECORDS:
+                rec = suite.get(name)
+                if rec and "error" not in rec and "skipped" not in rec:
+                    f.write(json.dumps(
+                        {"record": name, "captured_at": stamp,
+                         "commit": commit, **rec}
+                    ) + "\n")
+    except OSError:
+        pass
+
+
+_EVIDENCE_MAX_AGE_S = 14 * 3600  # one round is ~12h; never replay across rounds
+
+
+def _load_evidence():
+    """Newest evidence per record name from the round-long evidence file.
+
+    Records older than ``_EVIDENCE_MAX_AGE_S`` are dropped: the file is
+    append-only across rounds, and replaying a previous round's chip data
+    as this round's would attribute an old commit's performance to current
+    HEAD (each record still carries its ``commit`` and ``captured_at`` so
+    a replayed number is auditable)."""
+    import time
+
+    recs = {}
+    now = time.time()
+    try:
+        with open(_EVIDENCE_PATH) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                try:
+                    age = now - time.mktime(
+                        time.strptime(d.get("captured_at", ""),
+                                      "%Y-%m-%dT%H:%M:%S")
+                    )
+                except ValueError:
+                    continue
+                name = d.pop("record", None)
+                if name and age < _EVIDENCE_MAX_AGE_S:
+                    recs[name] = d  # file is append-only: last line wins
+    except OSError:
+        return {}
+    return recs
+
+
 def main() -> None:
     suite = {}
 
@@ -275,6 +428,7 @@ def main() -> None:
             suite[name] = {"error": f"{type(e).__name__}: {e}"}
 
     on_tpu, probe_reason = _tpu_reachable()
+    replayed = {}
     if not on_tpu:
         import jax
 
@@ -283,11 +437,16 @@ def main() -> None:
         # Same protocol, CPU-sized chains; the long-context and train-shape
         # workloads are pointless on one CPU core and are skipped explicitly
         # rather than silently timing out.
-        run("decode_64k", _decode_record, 16, 16, 64000, 2, 6)
-        skipped = {"skipped": "tpu unreachable; cpu fallback"}
-        for name in ("decode_gqa_128k", "decode_gqa_1m", "decode_mha_1m",
-                     "decode_64k_q8", "train_fwd_bwd"):
-            suite[name] = skipped
+        run("decode_64k_cpu", _decode_record, 16, 16, 64000, 2, 6)
+        evidence = _load_evidence()
+        for name in _TPU_RECORDS:
+            if name in evidence:
+                suite[name] = {
+                    **evidence[name], "measured_earlier_this_round": True,
+                }
+                replayed[name] = evidence[name]
+            else:
+                suite[name] = {"skipped": "tpu unreachable; cpu fallback"}
     else:
         run("decode_64k", _decode_record, 16, 16, 64000, 32, 128)
         run("decode_gqa_128k", _decode_record, 32, 4, 131072, 16, 64)
@@ -295,6 +454,9 @@ def main() -> None:
         run("decode_mha_1m", _decode_record, 16, 16, 1 << 20, 2, 8)
         run("decode_64k_q8", _decode_q8_record, 16, 16, 64000, 32, 128)
         run("train_fwd_bwd", _train_record)
+        # BASELINE config 2's shape (seq 16384): MFU progress toward the
+        # north star is tracked round over round at this length too.
+        run("train_fwd_bwd_16k", _train_record, 16384, 2, 8)
         # Allocator peak has no reset API, so a per-workload peak is not
         # observable in one process — record the process-lifetime peak once
         # (set by the largest workload, the 1M-context decode). Per-workload
@@ -305,16 +467,26 @@ def main() -> None:
         peak = _peak_hbm()
         if peak is not None:
             suite["peak_hbm_bytes_process"] = peak
+        _save_evidence(suite)
     run("tree_vs_ring_cpu8", _tree_vs_ring_record)
 
-    head = suite.get("decode_64k", {})
-    tokens_per_sec = head.get("kv_tokens_per_sec", 0.0)
     # The headline metric name carries the backend so a headline-only
     # consumer (the round-over-round BENCH_r{N} comparison) can never
-    # mistake a CPU-fallback number for the 1-chip TPU figure.
+    # mistake a CPU-fallback or replayed number for a live 1-chip TPU
+    # figure. Replayed evidence (chip data captured earlier in the round,
+    # before the tunnel wedged) beats a CPU number but is labeled.
     metric = "decode_kv_tokens_per_sec_64k_ctx_1chip"
+    if on_tpu:
+        head = suite.get("decode_64k", {})
+    elif "decode_64k" in replayed:
+        head = replayed["decode_64k"]
+        metric += "_REPLAYED"
+    else:
+        head = suite.get("decode_64k_cpu", {})
+        metric += "_CPUFALLBACK"
+    tokens_per_sec = head.get("kv_tokens_per_sec", 0.0)
     record = {
-        "metric": metric if on_tpu else metric + "_CPUFALLBACK",
+        "metric": metric,
         "value": tokens_per_sec,
         "unit": "tokens/sec",
         "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 2),
