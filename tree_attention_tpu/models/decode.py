@@ -100,7 +100,19 @@ def quantize_cache(cache: KVCache) -> QuantKVCache:
     Scales come from the filled prefix only — unwritten capacity rows are
     zeros and must not shrink the scale; rows appended later clamp to the
     prefix's range (attention values live in the prompt's activation
-    distribution, so the clamp is rare in practice).
+    distribution, so the clamp is rare in practice — measured by the
+    long-horizon drift test in ``tests/test_decode.py``).
+
+    Degenerate case (ADVICE r2): a channel that is *all-zero across the
+    prefill prefix* gets the contract's fallback scale of 1.0
+    (:func:`quantize_symmetric_int8`), so rows appended later quantize as
+    ``round(x)`` — sub-0.5 magnitudes collapse to 0 (absolute error ≤ 0.5,
+    relative error up to 100%). This is deliberate: no frozen scale can be
+    right for a channel the prefix carried no information about, and the
+    1.0 fallback bounds the *absolute* error where a tiny epsilon scale
+    would instead clamp ordinary activations to ~0 (unbounded relative
+    error the other way). Channels that are zero over a real prompt are
+    almost always dead (projection rows ~0), where any scale is exact.
     """
 
     from tree_attention_tpu.ops.pallas_decode import quantize_symmetric_int8

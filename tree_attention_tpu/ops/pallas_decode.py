@@ -302,9 +302,21 @@ def attention_pallas_decode(
     s = (D ** -0.5) if scale is None else scale
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    out_dtype = q.dtype
+    if k.dtype == jnp.int8 and q.dtype != jnp.bfloat16:
+        # The kernel casts int8 KV tiles to bf16 in-VMEM (exact for
+        # [-127, 127]); a non-bf16 q would make the score dot mixed-dtype
+        # and fail at trace time. Callers normally arrive via the q8
+        # wrapper, which folds scales into q in f32 and emits bf16; direct
+        # callers get the same operand precision applied here (ADVICE r2),
+        # with the output returned in their original dtype.
+        q = q.astype(jnp.bfloat16)
 
     if Tk == 0:
-        return jnp.zeros_like(q), jnp.full((B, Hq, Tq), NEG_INF, jnp.float32)
+        return (
+            jnp.zeros(q.shape, out_dtype),  # not zeros_like: q may be the
+            jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),  # bf16-cast copy
+        )
 
     # Pack each KV head's queries (its whole GQA group × Tq rows) into the
     # Q-tile sublanes: (B, Hq, Tq, D) -> (B·Hkv, r8, D).
@@ -360,6 +372,6 @@ def attention_pallas_decode(
         interpret=interpret,
     )(offs, qp, kp, vp)
 
-    out = out[:, :r].reshape(B, Hq, Tq, D)
+    out = out[:, :r].reshape(B, Hq, Tq, D).astype(out_dtype)
     lse = lse[:, :r, 0].reshape(B, Hq, Tq)
     return out, lse
