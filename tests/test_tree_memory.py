@@ -41,9 +41,13 @@ def test_chunked_matches_unchunked(layout, causal, q_chunk):
     if layout == "zigzag":
         q, k, v = (shard_zigzag(x, 2, n) for x in (q, k, v))
     mesh = cpu_mesh(n)
+    # impl="naive": the inner kernel is mostly irrelevant to chunk
+    # equivalence and the scan-free oracle keeps the many per-run
+    # compilations cheap; test_chunked_blockwise_integration below keeps
+    # one multi-chunk case on the blockwise kernel.
     run = functools.partial(
         tree_attention, mesh=mesh, causal=causal, layout=layout,
-        impl="blockwise", block_size=32,
+        impl="naive",
     )
     out_1, lse_1 = run(q, k, v, q_chunk=None)  # auto: one chunk at this size
     out_c, lse_c = run(q, k, v, q_chunk=q_chunk)
@@ -52,6 +56,26 @@ def test_chunked_matches_unchunked(layout, causal, q_chunk):
     )
     np.testing.assert_allclose(
         np.asarray(lse_c), np.asarray(lse_1), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_chunked_blockwise_integration():
+    """One multi-chunk (with tail) causal case on the *blockwise* kernel:
+    the chunked q_off plumbing must agree with the scan kernel's own
+    per-block masking/culling, not just the naive oracle's."""
+    rng = np.random.default_rng(5)
+    n = 4
+    q, k, v = _qkv(rng, T=256)
+    ref_out, ref_lse = attention_naive(q, k, v, causal=True)
+    out, lse = tree_attention(
+        q, k, v, mesh=cpu_mesh(n), causal=True, impl="blockwise",
+        block_size=32, q_chunk=48,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_out), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse), atol=2e-5, rtol=2e-5
     )
 
 
@@ -64,7 +88,7 @@ def test_chunked_matches_oracle_causal():
     qz, kz, vz = (shard_zigzag(x, 2, n) for x in (q, k, v))
     out, lse = tree_attention(
         qz, kz, vz, mesh=cpu_mesh(n), causal=True, layout="zigzag",
-        impl="blockwise", block_size=32, q_chunk=24,
+        impl="naive", q_chunk=24,
     )
     from tree_attention_tpu.parallel import unshard_zigzag
 
@@ -111,3 +135,39 @@ def test_temp_flat_or_shrinking_as_mesh_grows():
     t2 = _temp_bytes(cpu_mesh(2), q, k, v, q_chunk=256)
     t8 = _temp_bytes(cpu_mesh(8), q, k, v, q_chunk=256)
     assert t8 <= t2, (t8, t2)
+
+
+@pytest.mark.slow
+def test_256k_ctx_train_shape_step_on_8cpu_mesh():
+    """A 256k-token causal training-shape forward on the 8-device CPU mesh.
+
+    The point is feasibility (VERDICT r2 item 3): the previous all-gather
+    form materialised the global Q and its f32 numerator on every device —
+    at this length that transient alone dwarfs the per-device shard — and
+    did the full unculled T² work. With chunked gathering and live-FLOP
+    culling the step runs in slow-tier time. Correctness is pinned on the
+    first rows, whose causal receptive field is small enough for an exact
+    oracle: row r attends keys [0, r], so rows [0, 128) of the sharded
+    output must equal unsharded attention over the first 128 keys.
+    """
+    T, n, D = 1 << 18, 8, 16
+    rng = np.random.default_rng(4)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((1, 1, T, D), np.float32), jnp.float32
+    )
+    q, k, v = mk(), mk(), mk()
+    out, lse = tree_attention(
+        q, k, v, mesh=cpu_mesh(n), causal=True, impl="blockwise",
+        block_size=2048, q_chunk=4096,
+    )
+    out = np.asarray(out)
+    lse = np.asarray(lse)
+    # Full-array sanity first: a NaN from any later chunk's merge fails here.
+    assert np.isfinite(out).all() and np.isfinite(lse).all()
+    out = out[:, :, :128]
+    lse = lse[:, :, :128]
+    ref_out, ref_lse = attention_naive(
+        q[:, :, :128], k[:, :, :128], v[:, :, :128], causal=True
+    )
+    np.testing.assert_allclose(out, np.asarray(ref_out), atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(lse, np.asarray(ref_lse), atol=3e-5, rtol=3e-5)

@@ -460,7 +460,12 @@ def tree_attention(
       gathered at once: peak per-device transient is
       O(``n_shards·q_chunk·D``) instead of O(``T_global·D``). The default
       derives from ``TREE_ATTN_GATHER_BUDGET`` (bytes, default 256 MiB of
-      gathered Q + f32 numerator); small shapes resolve to one chunk.
+      gathered Q + f32 numerator), capped at ``TREE_ATTN_MAX_CHUNKS``
+      (default 16) chunks because the chunk loop is unrolled so run offsets
+      stay static — the auto transient is thus
+      ``max(budget, T_global·row_bytes/max_chunks)``; raise the cap or pass
+      ``q_chunk`` explicitly when the budget must win at extreme context.
+      Small shapes resolve to one chunk.
 
     ``layout`` selects how the sequence dim maps to shards:
 
@@ -523,9 +528,22 @@ def tree_attention(
         # numerator/output transient that exists at the same time.
         per_row = B * Hq * D * (q.dtype.itemsize + 8)
         q_chunk = max(budget // max(per_row * n_shards, 1), 1)
-        if q_chunk < Tq_local:
-            # Keep chunk boundaries lane-aligned when we can afford to.
-            q_chunk = max((q_chunk // 128) * 128, 1)
+        # The chunk loop is unrolled (each chunk's runs carry *static*
+        # offsets — a scan would trace them and kill the culling), so the
+        # auto policy also caps the chunk count (TREE_ATTN_MAX_CHUNKS,
+        # default 16) to keep compile size linear and small. The effective
+        # auto bound is therefore max(budget, T_global·row_bytes /
+        # max_chunks); raise the cap (or pass q_chunk explicitly — it is
+        # honored as given) when the budget must win at extreme context.
+        cap_floor = -(-Tq_local // int(
+            _os.environ.get("TREE_ATTN_MAX_CHUNKS", 16)
+        ))
+        q_chunk = max(q_chunk, cap_floor)
+        # Keep chunk boundaries lane-aligned when that respects both the
+        # budget (floor never exceeds it) and the chunk-count cap.
+        aligned = (q_chunk // 128) * 128
+        if Tq_local > q_chunk and aligned >= cap_floor and aligned >= 128:
+            q_chunk = aligned
     q_chunk = min(q_chunk, Tq_local)
     n_chunks = -(-Tq_local // q_chunk)
 
