@@ -144,7 +144,8 @@ def main():
             try:
                 per = measure(
                     lambda qc, k_, v_, bk=bk: attention_pallas_decode(
-                        qc, k_, v_, block_size=bk
+                        qc, k_, v_, causal=True, q_offset=T - 1,
+                        block_size=bk,
                     )[0],
                     q, k, v, ns, nl,
                 )
