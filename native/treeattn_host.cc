@@ -372,8 +372,22 @@ static int64_t ta_now_ms() {
 // semantics: every rank runs to its own exit, no peer killing, no deadline —
 // the contract of the plain ta_launch_processes API (ranks whose work is
 // independent must each report their own status).
+//
+// hb_dir != nullptr enables heartbeat stall detection (the failure mode the
+// crash supervisor cannot see: every rank alive but one wedged inside a
+// collective — an SPMD deadlock makes *all* peers stop heartbeating, so any
+// single stalled file is a reliable whole-job symptom). Each rank gets
+// TA_HEARTBEAT_FILE=<hb_dir>/hb.<rank> exported; the workload touches that
+// file as it makes progress (utime/close — see host_runtime.heartbeat).
+// Detection is clock-skew-robust: the mtime is only compared against its
+// *previous value* (a change marks progress) and aged with the monotonic
+// clock — never against wall-clock "now", which NTP can step. A rank whose
+// file hasn't changed (counting from launch, so size the window for jit
+// compile) for hb_stall_ms gets the whole job terminated; ranks killed by
+// the watchdog report 125, distinct from crash (128+sig) and deadline (124).
 static int ta_launch_common(const char* const* argv, int nprocs,
                             int timeout_ms, int grace_ms, int failfast,
+                            const char* hb_dir, int hb_stall_ms,
                             int* statuses) {
   std::vector<pid_t> pids(nprocs);
 
@@ -388,6 +402,9 @@ static int ta_launch_common(const char* const* argv, int nprocs,
     }
     env_strs[r].emplace_back("JAX_PROCESS_INDEX=" + std::to_string(r));
     env_strs[r].emplace_back("TA_NUM_PROCESSES=" + std::to_string(nprocs));
+    if (hb_dir)
+      env_strs[r].emplace_back(std::string("TA_HEARTBEAT_FILE=") + hb_dir +
+                               "/hb." + std::to_string(r));
     for (auto& s : env_strs[r]) envps[r].push_back(const_cast<char*>(s.c_str()));
     envps[r].push_back(nullptr);
   }
@@ -419,9 +436,14 @@ static int ta_launch_common(const char* const* argv, int nprocs,
   // marks "still running".
   std::vector<int> code(nprocs, -1);
   const int64_t t0 = ta_now_ms();
+  // Heartbeat tracking: last observed mtime (ns; -1 = never seen) and the
+  // monotonic time that value last *changed*.
+  std::vector<int64_t> hb_mtime(nprocs, -1);
+  std::vector<int64_t> hb_changed(nprocs, t0);
   int64_t kill_deadline = -1;  // set once termination has been requested
   bool terminating = false;
   bool timed_out = false;
+  bool stalled = false;
   int remaining = nprocs;
   while (remaining > 0) {
     bool reaped = false;
@@ -462,6 +484,33 @@ static int ta_launch_common(const char* const* argv, int nprocs,
       for (int k = 0; k < nprocs; ++k)
         if (code[k] < 0) kill(pids[k], SIGTERM);
     }
+    if (!terminating && hb_dir && hb_stall_ms > 0) {
+      for (int r = 0; r < nprocs && !terminating; ++r) {
+        if (code[r] >= 0) continue;
+        struct stat st;
+        const std::string path =
+            std::string(hb_dir) + "/hb." + std::to_string(r);
+        if (stat(path.c_str(), &st) == 0) {
+          const int64_t m =
+              static_cast<int64_t>(st.st_mtim.tv_sec) * 1000000000 +
+              st.st_mtim.tv_nsec;
+          if (m != hb_mtime[r]) {  // progress = the mtime *changed*
+            hb_mtime[r] = m;
+            hb_changed[r] = now;
+          }
+        }
+        // Before the first beat hb_changed holds the launch time, so a
+        // rank that never starts heartbeating (crash-looped import, wedged
+        // device init) is caught by the same window.
+        if (now - hb_changed[r] >= hb_stall_ms) {
+          terminating = true;
+          stalled = true;
+          kill_deadline = now + grace_ms;
+          for (int k = 0; k < nprocs; ++k)
+            if (code[k] < 0) kill(pids[k], SIGTERM);
+        }
+      }
+    }
     if (terminating && now >= kill_deadline) {
       for (int k = 0; k < nprocs; ++k)
         if (code[k] < 0) kill(pids[k], SIGKILL);
@@ -474,9 +523,11 @@ static int ta_launch_common(const char* const* argv, int nprocs,
   for (int r = 0; r < nprocs; ++r) {
     int c = code[r] < 0 ? 255 : code[r];
     // Ranks killed by the deadline report 124 (the timeout(1) convention)
-    // rather than 128+SIGTERM/KILL, so callers can tell "hung past the
-    // deadline" from "crashed".
+    // and ranks killed by the heartbeat watchdog report 125, rather than
+    // 128+SIGTERM/KILL, so callers can tell "hung past the deadline" and
+    // "stopped making progress" from "crashed".
     if (timed_out && (c == 128 + SIGTERM || c == 128 + SIGKILL)) c = 124;
+    if (stalled && (c == 128 + SIGTERM || c == 128 + SIGKILL)) c = 125;
     if (statuses) statuses[r] = c;
     if (c != 0) ++failures;
   }
@@ -486,7 +537,8 @@ static int ta_launch_common(const char* const* argv, int nprocs,
 // Run-to-completion: every rank's own exit status, no peer killing, no
 // deadline.
 int ta_launch_processes(const char* const* argv, int nprocs, int* statuses) {
-  return ta_launch_common(argv, nprocs, 0, 2000, /*failfast=*/0, statuses);
+  return ta_launch_common(argv, nprocs, 0, 2000, /*failfast=*/0,
+                          /*hb_dir=*/nullptr, 0, statuses);
 }
 
 // Supervised variant: fail-fast rank monitoring (see the comment block
@@ -495,7 +547,17 @@ int ta_launch_processes_supervised(const char* const* argv, int nprocs,
                                    int timeout_ms, int grace_ms,
                                    int* statuses) {
   return ta_launch_common(argv, nprocs, timeout_ms, grace_ms,
-                          /*failfast=*/1, statuses);
+                          /*failfast=*/1, /*hb_dir=*/nullptr, 0, statuses);
+}
+
+// Watched variant: fail-fast plus heartbeat stall detection (see the
+// comment block above ta_launch_common).
+int ta_launch_processes_watched(const char* const* argv, int nprocs,
+                                int timeout_ms, int grace_ms,
+                                const char* hb_dir, int hb_stall_ms,
+                                int* statuses) {
+  return ta_launch_common(argv, nprocs, timeout_ms, grace_ms,
+                          /*failfast=*/1, hb_dir, hb_stall_ms, statuses);
 }
 
 }  // extern "C"

@@ -243,3 +243,81 @@ class TestTokenCorpus:
         with hr.TokenCorpus(path) as c:
             with pytest.raises((ValueError, RuntimeError)):
                 c.fill_batch(1, 63, seed=0, batch_idx=0)
+
+
+class TestHeartbeatWatchdog:
+    """Hang detection (SURVEY §5 failure detection): the failure mode the
+    fail-fast supervisor cannot see — every rank alive, one wedged in a
+    collective. A rank silent past the stall window gets the job killed,
+    stalled ranks reporting 125 (vs 124 deadline / 128+sig crash)."""
+
+    # Rank 0 heartbeats briefly then stops beating while staying alive
+    # (the wedged-collective shape); rank 1 beats until killed.
+    _HANG = (
+        "import os, time\n"
+        "r = int(os.environ['JAX_PROCESS_INDEX'])\n"
+        "hb = os.environ['TA_HEARTBEAT_FILE']\n"
+        "def beat():\n"
+        "    open(hb, 'a').close(); os.utime(hb, None)\n"
+        "for i in range(600):\n"
+        "    if r == 0 and i >= 2: time.sleep(1)  # alive, no progress\n"
+        "    else: beat(); time.sleep(0.1)\n"
+    )
+    _HEALTHY = (
+        "import os, time\n"
+        "hb = os.environ['TA_HEARTBEAT_FILE']\n"
+        "for _ in range(8):\n"
+        "    open(hb, 'a').close(); os.utime(hb, None); time.sleep(0.1)\n"
+    )
+
+    def _run(self, code, **kw):
+        import time as _t
+
+        t0 = _t.monotonic()
+        failures, statuses = hr.launch_local(
+            [sys.executable, "-c", code], 2, grace=0.5, **kw
+        )
+        return failures, statuses, _t.monotonic() - t0
+
+    def test_stalled_rank_kills_job_with_125(self):
+        failures, statuses, elapsed = self._run(
+            self._HANG, heartbeat_stall=1.5
+        )
+        assert elapsed < 30, f"watchdog took {elapsed:.1f}s"
+        assert failures == 2
+        assert 125 in statuses, statuses
+        # Nothing crashed or hit a deadline: every kill is the watchdog's.
+        assert all(s == 125 for s in statuses), statuses
+
+    def test_beating_ranks_run_to_completion(self):
+        failures, statuses, elapsed = self._run(
+            self._HEALTHY, heartbeat_stall=5.0
+        )
+        assert failures == 0 and statuses == [0, 0], (statuses, elapsed)
+
+    def test_fallback_watchdog(self, monkeypatch):
+        monkeypatch.setattr(hr, "load_native", lambda: None)
+        failures, statuses, elapsed = self._run(
+            self._HANG, heartbeat_stall=1.5
+        )
+        assert elapsed < 30, f"watchdog took {elapsed:.1f}s"
+        assert failures == 2
+        assert all(s == 125 for s in statuses), statuses
+
+    def test_heartbeat_helper_is_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv("TA_HEARTBEAT_FILE", raising=False)
+        hr.heartbeat()  # must not raise
+
+    def test_heartbeat_helper_touches_file(self, tmp_path, monkeypatch):
+        p = tmp_path / "hb.0"
+        monkeypatch.setenv("TA_HEARTBEAT_FILE", str(p))
+        hr.heartbeat()
+        assert p.exists()
+
+    def test_requires_failfast(self):
+        with pytest.raises(ValueError, match="failfast"):
+            hr.launch_local(["true"], 1, failfast=False, heartbeat_stall=1.0)
+
+    def test_rejects_nonpositive_window(self):
+        with pytest.raises(ValueError, match="heartbeat_stall"):
+            hr.launch_local(["true"], 1, heartbeat_stall=0.0)

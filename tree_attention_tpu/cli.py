@@ -60,10 +60,12 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
         if skip:
             skip = False
             continue
-        if a in ("--launch", "--launch-timeout"):
+        if a in ("--launch", "--launch-timeout", "--heartbeat-stall"):
             skip = True
             continue
-        if a.startswith(("--launch=", "--launch-timeout=")):
+        if a.startswith(
+            ("--launch=", "--launch-timeout=", "--heartbeat-stall=")
+        ):
             continue
         child_args.append(a)
     cmd = [sys.executable, "-m", "tree_attention_tpu", *child_args]
@@ -75,7 +77,8 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
     os.environ["TA_COORDINATOR"] = f"localhost:{_pick_free_port()}"
     try:
         failures, statuses = launch_local(
-            cmd, cfg.launch, timeout=cfg.launch_timeout
+            cmd, cfg.launch, timeout=cfg.launch_timeout,
+            heartbeat_stall=cfg.heartbeat_stall,
         )
     finally:
         if prev is None:
@@ -290,10 +293,13 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     losses = []
     saved_last = True
     try:
+        from tree_attention_tpu.host_runtime import heartbeat
+
         for i in range(start, start + cfg.steps):
             batch = next_batch(i)
             state, loss = step(state, batch)
             losses.append(float(loss))
+            heartbeat()  # after the fetch: real per-step progress, not dispatch
             log.info("step %d: loss %.4f", i, losses[-1])
             if ckpt is not None:
                 saved_last = ckpt.save(i, state, cfg=tcfg)
@@ -350,14 +356,18 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
         jax.random.PRNGKey(cfg.seed + 1), (cfg.batch, max(cfg.q_len, 1)),
         0, tcfg.vocab_size,
     )
+    from tree_attention_tpu.host_runtime import heartbeat
+
     n_new = cfg.max_new_tokens
-    toks = generate(
+    heartbeat()  # generation is one dispatch: progress granularity is the
+    toks = generate(  # whole call, so the stall window must cover it
         params, prompt, n_new, tcfg,
         temperature=cfg.temperature, key=jax.random.PRNGKey(cfg.seed + 2),
         mesh=mesh,
         quantize_after_prefill=cfg.kv_quant == "int8",
     )
     toks = jax.block_until_ready(toks)
+    heartbeat()
     log.info(
         "generated %s tokens from a %s prompt%s",
         toks.shape, prompt.shape,

@@ -19,8 +19,10 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import signal
 import subprocess
+import tempfile
 import time
 import threading
 from typing import List, Optional, Sequence, Tuple
@@ -114,6 +116,14 @@ def load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
         ]
+        if hasattr(lib, "ta_launch_processes_watched"):
+            lib.ta_launch_processes_watched.restype = ctypes.c_int
+            lib.ta_launch_processes_watched.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
         if hasattr(lib, "ta_corpus_open"):
             lib.ta_corpus_open.restype = ctypes.c_void_p
             lib.ta_corpus_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -421,6 +431,24 @@ class HostCorpusPipeline(_PipelineBase):
 # ---------------------------------------------------------------------------
 
 
+def heartbeat() -> None:
+    """Mark this rank as making progress (cheap; call once per train step).
+
+    No-op unless the process was launched with heartbeat watching
+    (``launch_local(heartbeat_stall=...)`` exports ``TA_HEARTBEAT_FILE``).
+    Touching the file is the whole protocol: the supervisor compares its
+    mtime against the stall window.
+    """
+    path = os.environ.get("TA_HEARTBEAT_FILE")
+    if not path:
+        return
+    try:
+        with open(path, "a"):
+            os.utime(path, None)
+    except OSError:
+        pass  # never let observability kill the workload
+
+
 def launch_local(
     argv: Sequence[str],
     nprocs: int,
@@ -428,6 +456,7 @@ def launch_local(
     timeout: Optional[float] = None,
     grace: float = 2.0,
     failfast: bool = True,
+    heartbeat_stall: Optional[float] = None,
 ) -> Tuple[int, List[int]]:
     """Run ``nprocs`` copies of ``argv``, each with ``JAX_PROCESS_INDEX`` /
     ``TA_NUM_PROCESSES`` exported; returns (failure_count, per-rank statuses).
@@ -442,18 +471,61 @@ def launch_local(
     report status 124 (the ``timeout(1)`` convention). ``failfast=False``
     restores run-to-completion semantics (every rank's own exit status, no
     peer killing) — for workloads whose ranks are independent.
+
+    ``heartbeat_stall`` (seconds) arms the hang watchdog — the failure the
+    crash supervisor cannot see: every rank alive but wedged in a collective
+    (SPMD deadlocks stall *all* ranks, so one stalled heartbeat is a
+    reliable whole-job symptom). Each rank gets ``TA_HEARTBEAT_FILE``
+    exported and should call :func:`heartbeat` as it makes progress (the
+    CLI train loop does, once per step); a rank silent for longer than the
+    window — counted from launch until its first beat, so size it for jit
+    compile — gets the job killed, stalled ranks reporting status **125**
+    (vs 124 deadline, 128+sig crash). Requires ``failfast``.
     """
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
     if not failfast and timeout:
         raise ValueError("timeout requires failfast=True")
+    if heartbeat_stall is not None:
+        if not failfast:
+            raise ValueError("heartbeat_stall requires failfast=True")
+        if heartbeat_stall <= 0:
+            raise ValueError(
+                f"heartbeat_stall must be > 0, got {heartbeat_stall}"
+            )
+    hb_dir = None
+    if heartbeat_stall is not None:
+        hb_dir = tempfile.mkdtemp(prefix="ta_hb_")
+    try:
+        return _launch_local_impl(
+            argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir
+        )
+    finally:
+        if hb_dir is not None:
+            shutil.rmtree(hb_dir, ignore_errors=True)
+
+
+def _launch_local_impl(
+    argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir
+) -> Tuple[int, List[int]]:
     lib = load_native()
-    if lib is not None:
+    if lib is not None and (
+        heartbeat_stall is None or hasattr(lib, "ta_launch_processes_watched")
+    ):
         c_argv = (ctypes.c_char_p * (len(argv) + 1))(
             *[a.encode() for a in argv], None
         )
         statuses = (ctypes.c_int * nprocs)()
-        if failfast:
+        if heartbeat_stall is not None:
+            failures = lib.ta_launch_processes_watched(
+                c_argv, nprocs,
+                0 if not timeout else max(1, int(timeout * 1000)),
+                max(1, int(grace * 1000)),
+                hb_dir.encode(),
+                max(1, int(heartbeat_stall * 1000)),
+                statuses,
+            )
+        elif failfast:
             # timeout in (None, 0) = no deadline, the timeout(1) convention.
             failures = lib.ta_launch_processes_supervised(
                 c_argv, nprocs,
@@ -472,6 +544,8 @@ def launch_local(
         env = dict(os.environ)
         env["JAX_PROCESS_INDEX"] = str(r)
         env["TA_NUM_PROCESSES"] = str(nprocs)
+        if hb_dir is not None:
+            env["TA_HEARTBEAT_FILE"] = os.path.join(hb_dir, f"hb.{r}")
         procs.append(subprocess.Popen(list(argv), env=env))
     if not failfast:
         sts = [p.wait() for p in procs]
@@ -480,8 +554,14 @@ def launch_local(
     deadline = None if not timeout else time.monotonic() + timeout
     statuses: List[Optional[int]] = [None] * nprocs
     timed_out = False
+    stalled = False
     terminating = False
     kill_at = None
+    # Heartbeat tracking, clock-skew-robust: the mtime is only compared
+    # against its previous value (a change marks progress) and aged with
+    # the monotonic clock — never against wall-clock now, which NTP steps.
+    hb_mtime: List[Optional[float]] = [None] * nprocs
+    hb_changed = [time.monotonic()] * nprocs
     while any(s is None for s in statuses):
         for i, p in enumerate(procs):
             if statuses[i] is None and p.poll() is not None:
@@ -500,6 +580,25 @@ def launch_local(
             for q in procs:
                 if q.poll() is None:
                     q.terminate()
+        if not terminating and hb_dir is not None:
+            for i, p in enumerate(procs):
+                if statuses[i] is not None:
+                    continue
+                try:
+                    m = os.path.getmtime(os.path.join(hb_dir, f"hb.{i}"))
+                except OSError:
+                    m = None
+                if m is not None and m != hb_mtime[i]:
+                    hb_mtime[i] = m  # progress = the mtime changed
+                    hb_changed[i] = now
+                if now - hb_changed[i] >= heartbeat_stall:
+                    terminating = True
+                    stalled = True
+                    kill_at = now + grace
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    break
         if terminating and kill_at is not None and now >= kill_at:
             for q in procs:
                 if q.poll() is None:
@@ -513,5 +612,7 @@ def launch_local(
             c = 128 - c  # Popen reports -SIGNUM
         if timed_out and c in (128 + signal.SIGTERM, 128 + signal.SIGKILL):
             c = 124
+        if stalled and c in (128 + signal.SIGTERM, 128 + signal.SIGKILL):
+            c = 125
         out.append(c)
     return sum(1 for c in out if c != 0), out

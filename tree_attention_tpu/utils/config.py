@@ -54,6 +54,7 @@ class RunConfig:
     n_virtual_cpu: int = 0  # >0: force N virtual CPU devices (tests/emulation)
     launch: int = 0  # >1: respawn N coordinated processes (multi-host shape)
     launch_timeout: Optional[float] = None  # seconds; kill all ranks at expiry
+    heartbeat_stall: Optional[float] = None  # seconds; hang watchdog window
     impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
     block_size: Optional[int] = None  # None -> impl-appropriate default
     kv_quant: str = "none"  # none | int8 (decode/generate: quantized KV)
@@ -127,6 +128,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--launch-timeout", type=float, default=d.launch_timeout,
                    metavar="SEC", help="deadline for the whole --launch run; "
                    "ranks alive at expiry are killed (status 124)")
+    p.add_argument("--heartbeat-stall", type=float, default=d.heartbeat_stall,
+                   metavar="SEC", help="hang watchdog for --launch: a rank "
+                   "making no progress (no heartbeat; the train loop beats "
+                   "once per step) for SEC seconds gets the job killed, "
+                   "stalled ranks reporting status 125 — catches the "
+                   "all-ranks-alive collective deadlock the fail-fast "
+                   "supervisor cannot see. Size it for jit compile time.")
     p.add_argument("--batch", type=int, default=d.batch)
     p.add_argument("--seq-len", type=int, default=d.seq_len)
     p.add_argument("--q-len", type=int, default=d.q_len)

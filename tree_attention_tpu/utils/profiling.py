@@ -70,13 +70,17 @@ def time_fn(
         else:
             jax.block_until_ready(res)
 
+    from tree_attention_tpu.host_runtime import heartbeat
+
     for _ in range(max(warmup, 0)):
         fence(fn(*args, **kwargs))
+        heartbeat()  # each fenced iteration is host-visible progress
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         fence(fn(*args, **kwargs))
         times.append(time.perf_counter() - t0)
+        heartbeat()
     return TimingStats(
         median=statistics.median(times),
         mean=statistics.fmean(times),
