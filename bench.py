@@ -18,11 +18,12 @@ Workloads (VERDICT round-1 item 5 — one driver-parseable record):
   8-way sequence mesh (clean subprocess, CPU backend; the BASELINE.json
   north-star ratio's shape). Read it as a correctness/latency-shape check,
   NOT the north star: the emulation timeshares every "device" on the same
-  cores (so tree's log-depth collective advantage over ICI cannot appear),
-  and the jnp fallback culls dead causal work at KV-block granularity only
-  — ring's rotation steps cull fully, while tree's all-gathered-Q form
-  needs the Pallas kernels' 2D (Q-tile × KV-tile) culling, which only the
-  real-TPU path uses. Both biases favor ring.
+  cores, so wall clock tracks *total* FLOPs across shards and tree's
+  log-depth collective advantage over ICI cannot appear. Since the
+  per-run causal dispatch landed (r3), both algorithms cull to the same
+  live T²/2 on every impl, so parity (~1.0×) is the expected emulated
+  reading; the remaining tree-side costs are its merge collectives, which
+  the emulation prices at memcpy cost rather than wire cost.
 
 Measurement protocol (motivated by the tunneled-TPU transport this runs on,
 where ``block_until_ready`` can resolve before execution finishes and a host
