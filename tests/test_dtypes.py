@@ -132,6 +132,37 @@ def test_tree_decode_sharded_half_precision(name):
     )
 
 
+@pytest.mark.parametrize("name", ["bfloat16", "float16"])
+def test_tree_attention_sharded_half_precision(name):
+    """The training-shape chunked/culled tree path in half precision:
+    causal, zigzag, with a tail chunk — partials and the merge stay f32, so
+    sharded == the unsharded oracle to the dtype's own tier."""
+    from tree_attention_tpu.parallel import (
+        cpu_mesh, shard_zigzag, tree_attention, unshard_zigzag,
+    )
+
+    dtype, tol = DTYPES[name]
+    rng = np.random.default_rng(4)
+    _, _, _, (qj, kj, vj) = make_qkv(
+        rng, dtype, Hq=4, Hkv=4, Tq=128, Tk=128, D=32
+    )
+    n = 4
+    ref_out, ref_lse = attention_naive(qj, kj, vj, causal=True)
+    qz, kz, vz = (shard_zigzag(x, 2, n) for x in (qj, kj, vj))
+    out, lse = tree_attention(
+        qz, kz, vz, mesh=cpu_mesh(n), causal=True, layout="zigzag",
+        impl="naive", q_chunk=12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(unshard_zigzag(out, 2, n), np.float32),
+        np.asarray(ref_out, np.float32), atol=tol, rtol=tol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(unshard_zigzag(lse, 2, n)), np.asarray(ref_lse),
+        atol=LSE_TOL[name], rtol=LSE_TOL[name],
+    )
+
+
 def test_fp16_cli_decode_end_to_end():
     """--dtype float16 through the CLI decode path (accepted but previously
     untested; VERDICT round-1 missing item 5)."""
