@@ -21,7 +21,12 @@ import jax
 import jax.numpy as jnp
 
 from tree_attention_tpu.ops import attention_naive
-from tree_attention_tpu.parallel import cpu_mesh, shard_zigzag, tree_attention
+from tree_attention_tpu.parallel import (
+    cpu_mesh,
+    shard_zigzag,
+    tree_attention,
+    unshard_zigzag,
+)
 
 
 def _qkv(rng, B=1, H=2, T=512, D=32, dtype=np.float32):
@@ -90,8 +95,6 @@ def test_chunked_matches_oracle_causal():
         qz, kz, vz, mesh=cpu_mesh(n), causal=True, layout="zigzag",
         impl="naive", q_chunk=24,
     )
-    from tree_attention_tpu.parallel import unshard_zigzag
-
     np.testing.assert_allclose(
         np.asarray(unshard_zigzag(out, 2, n)), np.asarray(ref_out),
         atol=2e-5, rtol=2e-5,
@@ -183,8 +186,6 @@ def test_chunked_zigzag_gqa_matches_oracle():
     k = jnp.asarray(rng.standard_normal((2, 2, T, D), np.float32))
     v = jnp.asarray(rng.standard_normal((2, 2, T, D), np.float32))
     ref_out, ref_lse = attention_naive(q, k, v, causal=True)
-    from tree_attention_tpu.parallel import unshard_zigzag
-
     qz, kz, vz = (shard_zigzag(x, 2, n) for x in (q, k, v))
     out, lse = tree_attention(
         qz, kz, vz, mesh=cpu_mesh(n), causal=True, layout="zigzag",

@@ -60,12 +60,11 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
         if skip:
             skip = False
             continue
-        if a in ("--launch", "--launch-timeout", "--heartbeat-stall"):
+        parent_only = ("--launch", "--launch-timeout", "--heartbeat-stall")
+        if a in parent_only:
             skip = True
             continue
-        if a.startswith(
-            ("--launch=", "--launch-timeout=", "--heartbeat-stall=")
-        ):
+        if a.startswith(tuple(f + "=" for f in parent_only)):
             continue
         child_args.append(a)
     cmd = [sys.executable, "-m", "tree_attention_tpu", *child_args]
@@ -359,8 +358,10 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
     from tree_attention_tpu.host_runtime import heartbeat
 
     n_new = cfg.max_new_tokens
-    heartbeat()  # generation is one dispatch: progress granularity is the
-    toks = generate(  # whole call, so the stall window must cover it
+    # Generation is one dispatch: progress granularity is the whole call,
+    # so a watchdog stall window must cover it.
+    heartbeat()
+    toks = generate(
         params, prompt, n_new, tcfg,
         temperature=cfg.temperature, key=jax.random.PRNGKey(cfg.seed + 2),
         mesh=mesh,

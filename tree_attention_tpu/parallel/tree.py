@@ -30,6 +30,7 @@ dim) via optional extra mesh axes.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -39,7 +40,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 shard_map = jax.shard_map
 
-from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
+from tree_attention_tpu.ops import (
+    flash_attention,
+    mesh_platforms,
+    resolve_impl_for_mesh,
+)
 from tree_attention_tpu.ops.reference import NEG_INF, merge_partials
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
@@ -103,7 +108,7 @@ def unshard_zigzag(x: jax.Array, axis: int, n_shards: int) -> jax.Array:
 # than a second fused reduction operand. "split" is the default; the env
 # switch stays for re-measurement on multi-chip ICI, where the trade could
 # differ (payload count vs alignment, SURVEY.md §7 hard part 5).
-_MERGE_PAYLOAD = __import__("os").environ.get("TREE_ATTN_MERGE_PAYLOAD", "split")
+_MERGE_PAYLOAD = os.environ.get("TREE_ATTN_MERGE_PAYLOAD", "split")
 if _MERGE_PAYLOAD not in ("split", "packed"):
     raise ValueError(
         f"TREE_ATTN_MERGE_PAYLOAD must be 'split' or 'packed', "
@@ -505,8 +510,6 @@ def tree_attention(
     # Pallas kernels; elsewhere masking is elementwise anyway, so the cheap
     # 2-way (attend-with-traced-offset | skip) form compiles far less code
     # for the same live-FLOP culling.
-    from tree_attention_tpu.ops import mesh_platforms
-
     static_cull = impl in ("pallas", "pallas_decode") or (
         impl == "auto" and mesh_platforms(mesh) == {"tpu"}
     )
@@ -521,9 +524,7 @@ def tree_attention(
         half_k = Tk_local // 2
 
     if q_chunk is None:
-        import os as _os
-
-        budget = int(_os.environ.get("TREE_ATTN_GATHER_BUDGET", 1 << 28))
+        budget = int(os.environ.get("TREE_ATTN_GATHER_BUDGET", 1 << 28))
         # Gathered bytes per global row: the Q chunk itself plus the f32
         # numerator/output transient that exists at the same time.
         per_row = B * Hq * D * (q.dtype.itemsize + 8)
@@ -536,7 +537,7 @@ def tree_attention(
         # max_chunks); raise the cap (or pass q_chunk explicitly — it is
         # honored as given) when the budget must win at extreme context.
         cap_floor = -(-Tq_local // int(
-            _os.environ.get("TREE_ATTN_MAX_CHUNKS", 16)
+            os.environ.get("TREE_ATTN_MAX_CHUNKS", 16)
         ))
         q_chunk = max(q_chunk, cap_floor)
         # Keep chunk boundaries lane-aligned when that respects both the
