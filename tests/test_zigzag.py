@@ -206,10 +206,12 @@ def test_transformer_zigzag_train_step_runs():
     assert float(loss) > 0 and float(loss) == float(loss)
 
 
-def test_zigzag_pallas_static_cull_matches_oracle():
+@pytest.mark.parametrize("q_chunk", [None, 32])
+def test_zigzag_pallas_static_cull_matches_oracle(q_chunk):
     """Zigzag through the Pallas kernels (interpret): the static-offset
     dispatch (static_cull) with two KV half-segments per device — the
-    branch geometry the real-TPU path compiles — against the oracle."""
+    branch geometry the real-TPU path compiles — against the oracle, with
+    and without gather chunking (q_chunk=32 puts each chunk on one half)."""
     rng = np.random.default_rng(9)
     q, k, v = _qkv(rng, T=128, D=32)
     n = 2
@@ -218,7 +220,7 @@ def test_zigzag_pallas_static_cull_matches_oracle():
     qz, kz, vz = (shard_zigzag(x, 2, n) for x in (q, k, v))
     out_z, lse_z = tree_attention(
         qz, kz, vz, mesh=mesh, causal=True, layout="zigzag", impl="pallas",
-        block_size=32,
+        block_size=32, q_chunk=q_chunk,
     )
     np.testing.assert_allclose(
         np.asarray(unshard_zigzag(out_z, 2, n)), np.asarray(ref_out),
