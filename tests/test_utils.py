@@ -126,6 +126,46 @@ class TestProfiling:
         with pytest.raises(ValueError):
             time_per_step(lambda n: (lambda: None), n_small=8, n_large=8)
 
+    def test_time_per_step_min_stat(self):
+        # min-stat slope survives large positive RPC-style spikes that
+        # would flip the median-based slope negative: simulate durations by
+        # advancing a fake clock inside the timed call.
+        import itertools
+
+        import tree_attention_tpu.utils.profiling as prof
+        from tree_attention_tpu.utils.profiling import time_per_step
+
+        # Spikes drive the small side's MEDIAN above the large side's
+        # (median slope would be negative and raise); the min picks the one
+        # clean call per side and recovers the true 3 ms/step slope.
+        base = {2: 0.010 + 0.003 * 2, 10: 0.010 + 0.003 * 10}
+        spikes = {2: [0.5, 0.5, 0.0], 10: [0.0, 0.0, 0.5]}
+        state = {"t": 0.0}
+
+        def fake_fn(n):
+            seq = itertools.count()
+
+            def run():
+                i = next(seq)
+                state["t"] += base[n] + (spikes[n][i] if i < 3 else 0.0)
+
+            return run
+
+        real = prof.time.perf_counter
+        prof.time.perf_counter = lambda: state["t"]
+        try:
+            per, _, _ = time_per_step(
+                fake_fn, n_small=2, n_large=10, iters=3, warmup=0,
+                fetch=False, stat="min",
+            )
+        finally:
+            prof.time.perf_counter = real
+        assert abs(per - 0.003) < 1e-9
+
+        with pytest.raises(ValueError):
+            time_per_step(lambda n: (lambda: None), n_small=2, n_large=4,
+                          stat="p99")
+
     def test_time_fn_fetch_fence(self):
         stats = time_fn(lambda: jnp.arange(8.0) * 2, iters=2, warmup=1,
                         fetch=True)

@@ -1,0 +1,142 @@
+"""Round-3 follow-up chip experiments, batched into one tunnel client.
+
+Hypotheses from the first campaign (campaign.jsonl, 2026-07-31):
+
+1. decode at bk=2048 pays ~360 ns/tile of fixed overhead (measured 90% of
+   roofline at 64k); a larger KV tile amortises it — try bk=4096/8192 for
+   the exact kernel and the q8 kernel (q8 measured only 62% of its int8
+   roofline; its per-tile bf16 casts + overhead hurt relatively more at
+   half the bytes per tile).
+2. training fwd at 16k measured 57% MFU with (bq=512, bk=2048); wider tiles
+   may claw back the remaining pipeline overhead.
+
+Run:  python tools/experiments_r3.py > experiments_r3.jsonl
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def log(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def qkv(H, Hkv, Tq, T, D=128):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (
+        jax.random.normal(kq, (1, H, Tq, D), jnp.bfloat16),
+        jax.random.normal(kk, (1, Hkv, T, D), jnp.bfloat16),
+        jax.random.normal(kv, (1, Hkv, T, D), jnp.bfloat16),
+    )
+
+
+def chain(step, n):
+    def f(q, k, v):
+        def body(qc, _):
+            return step(qc, k, v).astype(qc.dtype), None
+
+        out = lax.scan(body, q, None, length=n)[0]
+        return jnp.sum(out.astype(jnp.float32))
+
+    return jax.jit(f)
+
+
+def measure(step, q, k, v, ns, nl, iters=5):
+    from tree_attention_tpu.utils.profiling import time_per_step
+
+    per, _, _ = time_per_step(
+        lambda n: chain(step, n), q, k, v, n_small=ns, n_large=nl,
+        iters=iters, warmup=1, stat="min",
+    )
+    return per
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", "experiments need the chip"
+    log({"stage": "start", "device": str(jax.devices()[0])})
+
+    from tree_attention_tpu.ops.pallas_decode import (
+        attention_pallas_decode,
+        attention_pallas_decode_q8,
+        quantize_kv_channelwise,
+    )
+
+    # --- exact decode: KV-tile sweep ---
+    for H, Hkv, T, ns, nl, bks in (
+        (16, 16, 64000, 64, 256, (2048, 4096, 8192)),
+        (32, 4, 1 << 20, 8, 32, (2048, 4096)),
+    ):
+        q, k, v = qkv(H, Hkv, 1, T)
+        for bk in bks:
+            try:
+                per = measure(
+                    lambda qc, k_, v_, bk=bk: attention_pallas_decode(
+                        qc, k_, v_, causal=True, q_offset=T - 1,
+                        block_size=bk,
+                    )[0],
+                    q, k, v, ns, nl,
+                )
+                bw = 2 * T * Hkv * 128 * 2 / per
+                log({"kernel": "decode", "H": H, "Hkv": Hkv, "T": T,
+                     "bk": bk, "us": round(per * 1e6, 1),
+                     "pct_roofline": round(bw / 819e9 * 100, 1)})
+            except Exception as e:
+                log({"kernel": "decode", "T": T, "bk": bk,
+                     "error": f"{type(e).__name__}: {e}"[:200]})
+
+    # --- q8 decode: KV-tile sweep (roofline % against int8 bytes) ---
+    q, k, v = qkv(16, 16, 1, 64000)
+    k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+    for bk in (2048, 4096, 8192):
+        try:
+            per = measure(
+                lambda qc, kq_, vq_, bk=bk: attention_pallas_decode_q8(
+                    qc, kq_, vq_, k_s, v_s, causal=True, q_offset=63999,
+                    block_size=bk,
+                )[0],
+                q, k_q, v_q, 64, 256,
+            )
+            bw = 2 * 64000 * 16 * 128 / per
+            log({"kernel": "decode_q8", "T": 64000, "bk": bk,
+                 "us": round(per * 1e6, 1),
+                 "pct_int8_roofline": round(bw / 819e9 * 100, 1)})
+        except Exception as e:
+            log({"kernel": "decode_q8", "T": 64000, "bk": bk,
+                 "error": f"{type(e).__name__}: {e}"[:200]})
+
+    # --- training fwd at 16k: wider tiles ---
+    from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+
+    def fwd_step(bq, bk):
+        def step(qc, k, v):
+            return attention_pallas_fwd(
+                qc, k, v, causal=True, block_q=bq, block_size=bk
+            )[0]
+
+        return step
+
+    T = 16384
+    flops = 2 * 2 * 16 * (T * T / 2) * 128
+    for bq, bk in ((512, 2048), (512, 4096), (768, 2048), (1024, 2048),
+                   (256, 4096)):
+        try:
+            per = measure(fwd_step(bq, bk), *qkv(16, 16, T, T), 4, 16)
+            log({"kernel": "fwd", "T": T, "bq": bq, "bk": bk,
+                 "us": round(per * 1e6, 1),
+                 "tflops": round(flops / per / 1e12, 1),
+                 "mfu_pct": round(flops / per / 197e12 * 100, 1)})
+        except Exception as e:
+            log({"kernel": "fwd", "T": T, "bq": bq, "bk": bk,
+                 "error": f"{type(e).__name__}: {e}"[:200]})
+
+    log({"stage": "done"})
+
+
+if __name__ == "__main__":
+    main()

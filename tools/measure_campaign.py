@@ -41,21 +41,26 @@ def qkv(H, Hkv, Tq, T, D=128):
 
 
 def chain(step, n):
+    # The chain returns a scalar reduction, not the carried tensor: the
+    # timing fence fetches the result to host, and on this tunnel a 64 MB
+    # fetch costs seconds of heavy-tailed RPC that drowns the slope
+    # (observed r3: 16k-shape chains at ~5 s/call, all fetch).
     def f(q, k, v):
         def body(qc, _):
             return step(qc, k, v).astype(qc.dtype), None
 
-        return lax.scan(body, q, None, length=n)[0]
+        out = lax.scan(body, q, None, length=n)[0]
+        return jnp.sum(out.astype(jnp.float32))
 
     return jax.jit(f)
 
 
-def measure(step, q, k, v, ns, nl, iters=3):
+def measure(step, q, k, v, ns, nl, iters=5):
     from tree_attention_tpu.utils.profiling import time_per_step
 
     per, _, _ = time_per_step(
         lambda n: chain(step, n), q, k, v, n_small=ns, n_large=nl,
-        iters=iters, warmup=1,
+        iters=iters, warmup=1, stat="min",
     )
     return per
 
@@ -115,7 +120,7 @@ def main():
     if quick:
         grid = [(256, 512), (512, 1024), (1024, 2048)]
     for bq, bk in grid:
-        per = run_one("fwd", 4096, bq, bk, 8, 32, fwd_step, flops_fwd(4096))
+        per = run_one("fwd", 4096, bq, bk, 16, 64, fwd_step, flops_fwd(4096))
         if per is not None:
             results[(bq, bk)] = per
     if not results:
@@ -126,18 +131,18 @@ def main():
 
     # --- stage 2: winners at 16k ---
     for bq, bk in top:
-        run_one("fwd", 16384, bq, bk, 4, 12, fwd_step, flops_fwd(16384))
+        run_one("fwd", 16384, bq, bk, 4, 16, fwd_step, flops_fwd(16384))
 
     # --- stage 3: fwd+bwd through the VJP on the winners ---
     for bq, bk in top:
-        run_one("bwd", 4096, bq, bk, 4, 12, bwd_step, flops_fwd(4096) * 3.5)
+        run_one("bwd", 4096, bq, bk, 8, 32, bwd_step, flops_fwd(4096) * 3.5)
 
     # --- stage 4: decode block_k spot checks ---
     from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
 
     for H, Hkv, T, ns, nl in (
-        (16, 16, 64000, 16, 48),
-        (32, 4, 1 << 20, 2, 6),
+        (16, 16, 64000, 64, 256),
+        (32, 4, 1 << 20, 8, 32),
     ):
         q, k, v = qkv(H, Hkv, 1, T)
         for bk in (1024, 2048) if not quick else (2048,):

@@ -10,11 +10,12 @@ this repo is benched on. Lookup is by bucket:
   only trade-off is fewer grid steps (bigger bk) vs VMEM and ragged-tail
   waste.
 
+- training (Tq >= 128): ``_TRAIN_TILES`` keyed by sequence length, from the
+  round-3 ``tools/measure_campaign.py`` sweep (fwd-first, fwd+bwd tiebreak).
+
 Callers pass ``block_size=None`` / ``block_q=None`` end to end to land here;
 any explicit value wins unchanged. ``block_q`` is threaded through the
-dispatcher and the custom VJP; :func:`default_block_q` is where a measured
-training-fwd table lands once ``tools/tune_sweep.py fwd`` finds shape
-classes where the round-1 defaults (bq=256, bk=512) lose.
+dispatcher and the custom VJP.
 """
 
 from __future__ import annotations
@@ -47,10 +48,29 @@ def tpu_kernel_for(tq: int) -> str:
     return "pallas_decode" if tq < DECODE_KERNEL_MAX_TQ else "pallas"
 
 
+# (seq-length upper bound, block_q, block_k) for the Q-tiled training
+# kernel. Measured by tools/measure_campaign.py on v5e, 2026-07-31
+# (campaign.jsonl, min-stat slope protocol): (512, 2048) wins the fwd sweep
+# at both 4k (879 us, 78 TFLOP/s) and 16k (10.5 ms, 105 TFLOP/s) and the
+# fwd+bwd sweep at 4k (2.0 ms, ~119 TFLOP/s); the round-1 defaults
+# (256, 512) measure 2.5x slower fwd at 4k. Both kernels clamp tiles to the
+# actual shape, so the table is safe for short sequences too.
+_TRAIN_TILES = (
+    (float("inf"), 512, 2048),
+)
+
+
+def _train_tile(t: int):
+    for bound, bq, bk in _TRAIN_TILES:
+        if t <= bound:
+            return bq, bk
+    raise AssertionError("unreachable")
+
+
 def default_block_size(impl: str, tk: int) -> int:
-    return decode_block_k(tk) if impl == "pallas_decode" else 512
+    return decode_block_k(tk) if impl == "pallas_decode" else _train_tile(tk)[1]
 
 
 def default_block_q(tq: int, tk: int) -> int:
     """Q-tile length for the Q-tiled Pallas kernel (fwd + bwd)."""
-    return 256
+    return _train_tile(tq)[0]

@@ -99,6 +99,7 @@ def time_per_step(
     iters: int = 5,
     warmup: int = 1,
     fetch: bool = True,
+    stat: str = "median",
     **kwargs: Any,
 ) -> Tuple[float, TimingStats, TimingStats]:
     """Amortised per-step cost by slope: time an ``n_small``-step and an
@@ -108,9 +109,23 @@ def time_per_step(
     the completion fence — leaving only the marginal cost of one step.
     ``make_fn(n)`` must return a callable running ``n`` dependent steps.
     Returns ``(seconds_per_step, stats_small, stats_large)``.
+
+    ``stat`` picks the per-side estimator: ``"median"`` (default) or
+    ``"min"``. Tunnel RPC noise is strictly additive and heavy-tailed
+    (observed multi-hundred-ms spikes on an idle host), so the minimum over
+    ``iters`` repetitions converges to the true time and is the right choice
+    on the tunneled TPU backend; the median is kept as the default for
+    backends where run-to-run variance is symmetric.
+
+    Protocol note: have the chain return a small *reduction* of its output
+    (e.g. ``out.sum()``), not the full tensor — the fence fetches the result
+    to host, and a multi-MB fetch adds seconds of jittery RPC per call that
+    the slope then has to cancel.
     """
     if not 0 < n_small < n_large:
         raise ValueError(f"need 0 < n_small < n_large, got {n_small}, {n_large}")
+    if stat not in ("median", "min"):
+        raise ValueError(f"stat must be 'median' or 'min', got {stat!r}")
     s_small = time_fn(
         make_fn(n_small), *args, iters=iters, warmup=warmup, fetch=fetch,
         **kwargs,
@@ -119,12 +134,13 @@ def time_per_step(
         make_fn(n_large), *args, iters=iters, warmup=warmup, fetch=fetch,
         **kwargs,
     )
-    per_step = (s_large.median - s_small.median) / (n_large - n_small)
+    pick = (lambda s: s.minimum) if stat == "min" else (lambda s: s.median)
+    per_step = (pick(s_large) - pick(s_small)) / (n_large - n_small)
     if per_step <= 0:
         raise RuntimeError(
-            f"non-positive per-step slope ({per_step:.3e}s): medians "
-            f"n={n_small}: {s_small.median:.6f}s, n={n_large}: "
-            f"{s_large.median:.6f}s — measurement noise exceeds the "
+            f"non-positive per-step slope ({per_step:.3e}s): {stat}s "
+            f"n={n_small}: {pick(s_small):.6f}s, n={n_large}: "
+            f"{pick(s_large):.6f}s — measurement noise exceeds the "
             f"workload; raise n_large or iters"
         )
     return per_step, s_small, s_large
