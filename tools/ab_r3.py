@@ -1,0 +1,134 @@
+"""Interleaved A/B re-measurements for ambiguous round-3 records.
+
+Run-to-run process variance on the tunnel is ~±5-10%, which is the same
+order as some tile-choice effects; alternating the configs inside ONE
+process separates the config effect from drift. Also validates the bwd
+block_q VMEM cap at T=16384 on-chip (the compile-time OOM this fixes was
+only reachable on real hardware).
+
+Run:  python tools/ab_r3.py > ab_r3.jsonl
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def log(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def qkv(H, Hkv, Tq, T, D=128, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(kq, (1, H, Tq, D), jnp.bfloat16),
+        jax.random.normal(kk, (1, Hkv, T, D), jnp.bfloat16),
+        jax.random.normal(kv, (1, Hkv, T, D), jnp.bfloat16),
+    )
+
+
+def chain(step, n):
+    def f(q, k, v):
+        def body(qc, _):
+            return step(qc, k, v).astype(qc.dtype), None
+
+        out = lax.scan(body, q, None, length=n)[0]
+        return jnp.sum(out.astype(jnp.float32))
+
+    return jax.jit(f)
+
+
+def measure(step, q, k, v, ns, nl, iters=5):
+    from tree_attention_tpu.utils.profiling import time_per_step
+
+    per, _, _ = time_per_step(
+        lambda n: chain(step, n), q, k, v, n_small=ns, n_large=nl,
+        iters=iters, warmup=1, stat="min",
+    )
+    return per
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", "A/B needs the chip"
+    log({"stage": "start", "device": str(jax.devices()[0])})
+
+    from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+    def decode_step(T, bk):
+        def step(qc, k, v):
+            return attention_pallas_decode(
+                qc, k, v, causal=True, q_offset=T - 1, block_size=bk
+            )[0]
+
+        return step
+
+    # --- decode A/B: alternate tile sizes within one process ---
+    for H, Hkv, T, ns, nl, reps, bks in (
+        (32, 4, 131072, 32, 128, 3, (2048, 4096)),
+        (16, 16, 64000, 64, 256, 2, (2048, 4096)),
+        (32, 4, 1 << 20, 8, 32, 2, (2048, 4096)),
+    ):
+        q, k, v = qkv(H, Hkv, 1, T)
+        for rep in range(reps):
+            for bk in bks:
+                try:
+                    per = measure(decode_step(T, bk), q, k, v, ns, nl)
+                    bw = 2 * T * Hkv * 128 * 2 / per
+                    log({"kernel": "decode", "H": H, "Hkv": Hkv, "T": T,
+                         "bk": bk, "rep": rep, "us": round(per * 1e6, 1),
+                         "pct_roofline": round(bw / 819e9 * 100, 1)})
+                except Exception as e:
+                    log({"kernel": "decode", "T": T, "bk": bk, "rep": rep,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
+
+    # --- fwd+bwd at 16k through the default (table) tiles: validates the
+    # bwd block_q cap compiles and runs where the uncapped tile VMEM-OOMs ---
+    from tree_attention_tpu.ops import flash_attention
+
+    def bwd_step(qc, k, v):
+        def loss(q_):
+            o, _ = flash_attention(q_, k, v, causal=True, impl="pallas")
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        return jax.grad(loss)(qc)
+
+    for T, ns, nl in ((16384, 2, 8),):
+        try:
+            per = measure(bwd_step, *qkv(16, 16, T, T), ns, nl)
+            flops = 2 * 2 * 16 * (T * T / 2) * 128 * 3.5
+            log({"kernel": "bwd_defaults", "T": T,
+                 "us": round(per * 1e6, 1),
+                 "tflops": round(flops / per / 1e12, 1)})
+        except Exception as e:
+            log({"kernel": "bwd_defaults", "T": T,
+                 "error": f"{type(e).__name__}: {e}"[:300]})
+
+    # --- train fwd 4k twice: gauges within-process repeatability ---
+    from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+
+    def fwd_step(qc, k, v):
+        return attention_pallas_fwd(
+            qc, k, v, causal=True, block_q=512, block_size=2048
+        )[0]
+
+    for rep in range(2):
+        try:
+            per = measure(fwd_step, *qkv(16, 16, 4096, 4096), 16, 64)
+            flops = 2 * 2 * 16 * (4096 * 4096 / 2) * 128
+            log({"kernel": "fwd", "T": 4096, "rep": rep,
+                 "us": round(per * 1e6, 1),
+                 "tflops": round(flops / per / 1e12, 1)})
+        except Exception as e:
+            log({"kernel": "fwd", "T": 4096, "rep": rep,
+                 "error": f"{type(e).__name__}: {e}"[:200]})
+
+    log({"stage": "done"})
+
+
+if __name__ == "__main__":
+    main()

@@ -145,7 +145,7 @@ def main():
         (32, 4, 1 << 20, 8, 32),
     ):
         q, k, v = qkv(H, Hkv, 1, T)
-        for bk in (1024, 2048) if not quick else (2048,):
+        for bk in (2048, 4096) if not quick else (4096,):
             try:
                 per = measure(
                     lambda qc, k_, v_, bk=bk: attention_pallas_decode(

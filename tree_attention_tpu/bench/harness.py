@@ -155,20 +155,18 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
             attention_pallas_decode_q8,
             quantize_kv_channelwise,
         )
-        from tree_attention_tpu.ops.tuning import decode_block_k
 
         # Per-channel scales are shard-invariant, so global quantization
         # shards as-is (jnp ops run distributed on sharded inputs).
         k, v, k_s, v_s = quantize_kv_channelwise(k, v)
         extra = {"kv_quant": "int8"}
         if mesh is None:
-            bk = (
-                decode_block_k(cfg.seq_len) if cfg.block_size is None
-                else cfg.block_size
-            )
             name = "decode_q8"
+            # block_size=None resolves inside the wrapper via the q8 tile
+            # table — the bench times the production default path.
             fn = jax.jit(lambda q, k, v: attention_pallas_decode_q8(
-                q, k, v, k_s, v_s, causal=cfg.causal, block_size=bk,
+                q, k, v, k_s, v_s, causal=cfg.causal,
+                block_size=cfg.block_size,
             )[0])
         else:
             name = "tree_decode_q8"

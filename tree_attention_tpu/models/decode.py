@@ -401,12 +401,12 @@ def decode_attention(
         from tree_attention_tpu.ops.pallas_decode import (
             attention_pallas_decode_q8,
         )
-        from tree_attention_tpu.ops.tuning import decode_block_k
 
-        bk = decode_block_k(k.shape[2]) if block_size is None else block_size
+        # block_size=None resolves inside the wrapper via the q8 tile table
+        # (the one home of that default).
         return attention_pallas_decode_q8(
             q, k, v, k_scale, v_scale, causal=True,
-            q_offset=q_position, block_size=bk,
+            q_offset=q_position, block_size=block_size,
         )
     return flash_decode(
         q, k, v, q_position=q_position, num_splits=num_splits,

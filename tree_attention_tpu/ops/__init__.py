@@ -177,13 +177,23 @@ def flash_attention(
             impl = "naive"
         else:
             impl = "blockwise"
+    # None picks tuned defaults. The bwd kernels get their own (VMEM-capped)
+    # default Q tile only when the caller left block_q to the table; an
+    # explicit block_q flows to both passes unchanged so tuning sweeps
+    # measure what they label.
+    block_q_bwd = block_q
     if block_size is None or (block_q is None and impl == "pallas"):
-        from tree_attention_tpu.ops.tuning import default_block_q, default_block_size
+        from tree_attention_tpu.ops.tuning import (
+            default_block_q,
+            default_block_q_bwd,
+            default_block_size,
+        )
 
         if block_size is None:
             block_size = default_block_size(impl, k.shape[2])
         if block_q is None and impl == "pallas":
             block_q = default_block_q(q.shape[2], k.shape[2])
+            block_q_bwd = default_block_q_bwd(q.shape[2], k.shape[2])
     if impl == "naive":
         # Raw autodiff path: the differential oracle the custom VJP is
         # tested against.
@@ -228,4 +238,5 @@ def flash_attention(
         q, k, v, causal=causal, scale=scale, q_offset=q_offset,
         kv_offset=kv_offset, impl=impl, block_size=block_size,
         block_q=block_q if impl == "pallas" else None,
+        block_q_bwd=block_q_bwd if impl == "pallas" else None,
     )

@@ -215,7 +215,7 @@ def attention_pallas_decode_q8(
     scale: Optional[float] = None,
     q_offset=0,
     kv_offset=0,
-    block_size: int = 2048,
+    block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode over an int8-quantized KV buffer.
@@ -247,6 +247,9 @@ def attention_pallas_decode_q8(
             f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
         )
     G = Hq // Hkv
+    # block_size=None falls through to the base kernel, which resolves it
+    # from the q8 tile table when K/V are int8 (the one home of that
+    # default).
     # Fold K's per-channel scale into Q: (q ⊙ k_s)·k_qᵀ == q·(k_q ⊙ k_s)ᵀ.
     # The fold runs in f32; the folded Q is carried bf16 into the kernel
     # (the MXU fast path, and the same operand precision the unquantized
@@ -281,7 +284,7 @@ def attention_pallas_decode(
     scale: Optional[float] = None,
     q_offset=0,
     kv_offset=0,
-    block_size: int = 2048,
+    block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode. Same ``(out, lse)`` contract as the other impls.
@@ -324,6 +327,17 @@ def attention_pallas_decode(
     bq = min(-(-r // 8) * 8, 128)
     qp = _pad_dim(q.reshape(B, Hkv, r, D), 2, bq).reshape(B * Hkv, -1, D)
     n_q = qp.shape[1] // bq
+
+    if block_size is None:
+        from tree_attention_tpu.ops.tuning import decode_block_k, decode_block_k_q8
+
+        # Direct int8 callers (the q8 wrapper normally resolves first) get
+        # the q8 table: half the bytes per tile leaves the exact path's tile
+        # size overhead-bound (measured 76.3% vs 85.2% of the int8 roofline
+        # at 64k).
+        block_size = (
+            decode_block_k_q8(Tk) if k.dtype == jnp.int8 else decode_block_k(Tk)
+        )
 
     # No host-side KV padding: Pallas handles a ragged last block itself and
     # the kernel's ``col_idx < tk`` mask drops the garbage columns. An

@@ -20,18 +20,38 @@ dispatcher and the custom VJP.
 
 from __future__ import annotations
 
-# context-length upper bound -> block_k. From tools/tune_sweep.py on v5e
-# (bigger contexts amortise per-tile cost over more streaming; VMEM caps the
-# top end).
+# context-length upper bound -> block_k. Measured on v5e (tools/tune_sweep.py
+# round 2; tools/experiments_r3.py 2026-07-31): bigger contexts amortise the
+# ~360 ns/tile fixed cost over more streaming — 64k MHA measures 92.5% of
+# the HBM roofline at bk=4096 vs 89.9% at 2048, and 1M GQA 91.6% at 4096
+# with high run variance at 2048. VMEM caps the top end.
 _DECODE_BLOCK_K = (
     (16_384, 1024),
-    (262_144, 2048),
-    (float("inf"), 2048),
+    (float("inf"), 4096),
 )
+
+# The int8 cache streams half the bytes per tile, so the per-tile fixed cost
+# weighs twice as much relative to DMA — the q8 kernel wants tiles ~2x the
+# exact path's. Measured 2026-07-31 (64k ctx): 62.2% of the int8 roofline at
+# bk=2048, 76.3% at 4096, 85.2% at 8192 (375.9 us = 1.89x the exact path's
+# tokens/sec).
+_DECODE_BLOCK_K_Q8 = (
+    (16_384, 2048),
+    (float("inf"), 8192),
+)
+
 
 def decode_block_k(tk: int) -> int:
     """KV tile length for the flash-decode kernel."""
     for bound, bk in _DECODE_BLOCK_K:
+        if tk <= bound:
+            return bk
+    raise AssertionError("unreachable")
+
+
+def decode_block_k_q8(tk: int) -> int:
+    """KV tile length for the int8-cache flash-decode kernel."""
+    for bound, bk in _DECODE_BLOCK_K_Q8:
         if tk <= bound:
             return bk
     raise AssertionError("unreachable")
@@ -49,14 +69,16 @@ def tpu_kernel_for(tq: int) -> str:
 
 
 # (seq-length upper bound, block_q, block_k) for the Q-tiled training
-# kernel. Measured by tools/measure_campaign.py on v5e, 2026-07-31
-# (campaign.jsonl, min-stat slope protocol): (512, 2048) wins the fwd sweep
-# at both 4k (879 us, 78 TFLOP/s) and 16k (10.5 ms, 105 TFLOP/s) and the
-# fwd+bwd sweep at 4k (2.0 ms, ~119 TFLOP/s); the round-1 defaults
-# (256, 512) measure 2.5x slower fwd at 4k. Both kernels clamp tiles to the
-# actual shape, so the table is safe for short sequences too.
+# kernel. Measured by tools/measure_campaign.py + tools/experiments_r3.py
+# on v5e, 2026-07-31 (min-stat slope protocol): (512, 2048) wins the fwd
+# sweep at 4k (879 us, 78 TFLOP/s — the round-1 (256, 512) defaults measure
+# 2.5x slower) and the fwd+bwd sweep at 4k (2.0 ms, ~119 TFLOP/s); at 16k
+# the deeper Q tile (1024, 2048) wins fwd (9.9 ms, 111.5 TFLOP/s vs 102.3
+# for bq=512). Both kernels clamp tiles to the actual shape, so the table
+# is safe for short sequences too.
 _TRAIN_TILES = (
-    (float("inf"), 512, 2048),
+    (8192, 512, 2048),
+    (float("inf"), 1024, 2048),
 )
 
 
@@ -71,6 +93,21 @@ def default_block_size(impl: str, tk: int) -> int:
     return decode_block_k(tk) if impl == "pallas_decode" else _train_tile(tk)[1]
 
 
+# VMEM ceiling for the backward kernels' Q tile. The bwd kernels hold more
+# per-tile live state than the forward (recomputed s/p/ds alongside the
+# dq/dkv accumulators): (bq=1024, bk=2048) measures 24.6 MB of scoped VMEM
+# against the v5e's 16 MB limit — a compile-time OOM (observed 2026-07-31,
+# T=16384). Applied only when the tile comes from this table's defaults;
+# an explicitly passed block_q always wins unchanged (sweeps must measure
+# what they label).
+BWD_MAX_BLOCK_Q = 512
+
+
 def default_block_q(tq: int, tk: int) -> int:
-    """Q-tile length for the Q-tiled Pallas kernel (fwd + bwd)."""
+    """Q-tile length for the Q-tiled Pallas forward kernel."""
     return _train_tile(tq)[0]
+
+
+def default_block_q_bwd(tq: int, tk: int) -> int:
+    """Q-tile length for the Pallas backward kernels (VMEM-capped)."""
+    return min(default_block_q(tq, tk), BWD_MAX_BLOCK_Q)

@@ -46,7 +46,12 @@ class _Cfg(NamedTuple):
     scale: Optional[float]
     impl: str
     block_size: int
-    block_q: Optional[int] = None  # Pallas Q-tile; None = kernel default
+    block_q: Optional[int] = None  # Pallas fwd Q-tile; None = kernel default
+    # Pallas bwd Q-tile; None = block_q. The dispatcher threads a smaller
+    # default here (tuning.default_block_q_bwd): the bwd kernels' larger
+    # per-tile live state VMEM-OOMs at the fwd-optimal tile. An explicit
+    # caller block_q flows to both passes unchanged.
+    block_q_bwd: Optional[int] = None
     # Static copies of integer offsets. Residuals flow through custom_vjp as
     # arrays, which would hide compile-time offsets from the backward and
     # silently disable the Pallas kernels' grid-level causal culling; carrying
@@ -113,7 +118,8 @@ def _attn_bwd(cfg, residuals, cotangents):
         from tree_attention_tpu.ops.pallas_bwd import attention_bwd_pallas
 
         bwd = attention_bwd_pallas
-        kw = {} if cfg.block_q is None else {"block_q": cfg.block_q}
+        bq = cfg.block_q if cfg.block_q_bwd is None else cfg.block_q_bwd
+        kw = {} if bq is None else {"block_q": bq}
     else:
         bwd = attention_bwd_blockwise
         kw = {}
@@ -141,6 +147,7 @@ def flash_attention_vjp(
     impl: str = "blockwise",
     block_size: int = 512,
     block_q: Optional[int] = None,
+    block_q_bwd: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Differentiable attention with the flash (recompute) backward."""
     q_off = kv_off = None
@@ -148,7 +155,7 @@ def flash_attention_vjp(
         q_off, kv_off = int(q_offset), int(kv_offset)
     cfg = _Cfg(
         causal=causal, scale=scale, impl=impl, block_size=block_size,
-        block_q=block_q, q_off=q_off, kv_off=kv_off,
+        block_q=block_q, block_q_bwd=block_q_bwd, q_off=q_off, kv_off=kv_off,
     )
     return _attn(cfg, q, k, v, q_offset, kv_offset)
 

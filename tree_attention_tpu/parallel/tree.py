@@ -296,11 +296,11 @@ def tree_decode_q8(
     cost — while the collective payload is unchanged.
     """
     from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode_q8
-    from tree_attention_tpu.ops.tuning import decode_block_k
+    from tree_attention_tpu.ops.tuning import decode_block_k_q8
 
     n_shards = mesh.shape[seq_axis]
     Tk_local = k_q.shape[2] // max(n_shards, 1)
-    bk = decode_block_k(max(Tk_local, 1)) if block_size is None else block_size
+    bk = decode_block_k_q8(max(Tk_local, 1)) if block_size is None else block_size
     # Inside shard_map the arrays are tracers, so the kernel's own
     # interpret auto-detection would consult the default backend — wrong
     # when the mesh lives on a different platform (an emulated CPU mesh on
