@@ -560,4 +560,34 @@ int ta_launch_processes_watched(const char* const* argv, int nprocs,
                           /*failfast=*/1, hb_dir, hb_stall_ms, statuses);
 }
 
+// Elastic variant: fail-fast supervision with bounded whole-gang restart.
+// On a failed attempt (rank crash, deadline, heartbeat stall) the gang is
+// torn down by the fail-fast machinery and the SAME argv is re-exec'd, up
+// to max_restarts additional attempts. Whole-gang restart is the right
+// granularity for SPMD: a surviving rank is wedged in a collective the
+// moment any peer dies, so there is nothing to rejoin — the workload is
+// expected to be resumable (restore its latest checkpoint on start; the
+// CLI's --resume contract). timeout_ms is a PER-ATTEMPT deadline. The
+// heartbeat stall window restarts from each attempt's launch. statuses
+// holds the LAST attempt's ranks; *attempts (if non-null) receives the
+// number of attempts run. A launch-machinery failure (fork: rc -1) is not
+// retried — the host is sick, not the gang.
+int ta_launch_processes_elastic(const char* const* argv, int nprocs,
+                                int timeout_ms, int grace_ms,
+                                const char* hb_dir, int hb_stall_ms,
+                                int max_restarts, int* statuses,
+                                int* attempts) {
+  int failures = -1;
+  int attempt = 0;
+  for (;;) {
+    ++attempt;
+    failures = ta_launch_common(argv, nprocs, timeout_ms, grace_ms,
+                                /*failfast=*/1, hb_dir, hb_stall_ms,
+                                statuses);
+    if (failures <= 0 || attempt > max_restarts) break;
+  }
+  if (attempts) *attempts = attempt;
+  return failures;
+}
+
 }  // extern "C"

@@ -21,9 +21,11 @@ TINY = [
 ]
 
 
-def run_cli(*args, timeout=180):
+def run_cli(*args, timeout=180, env_extra=None):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # the CLI sets its own virtual-device flags
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.run(
         [sys.executable, "-m", "tree_attention_tpu", *args],
         capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env,
@@ -183,6 +185,49 @@ class TestCLI:
             "--launch", "2", "--mesh", "data=2", timeout=300,
         )
         assert record["mode"] == "train" and len(record["losses"]) == 2
+
+    def test_launch_elastic_recovers_from_rank_crash(self, tmp_path):
+        # End-to-end elastic recovery: rank 1 is killed by fault injection
+        # at step 2 of the first gang attempt (the once-file is consumed, so
+        # only that attempt crashes); the parent relaunches the gang with
+        # --resume, the children restore a committed checkpoint, and the job
+        # completes with a single clean record. The fault fires at step 2,
+        # not 1, so the step-0 save is deterministically durable: Orbax
+        # saves are async, and queueing save(1) fences the in-flight
+        # save(0). This is the recovery story the reference lacks entirely
+        # (a crashed rank hangs its peers' allreduce forever,
+        # model.py:108,163).
+        once = tmp_path / "fault_once"
+        once.write_text("")
+        ckpt = tmp_path / "ckpt"
+        record, logs = run_cli(
+            "--mode", "train", "--device", "cpu", "--seq-len", "64",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "3", "--batch", "2",
+            "--dtype", "float32", "--iters", "1",
+            "--launch", "2", "--mesh", "data=2", "--restarts", "1",
+            "--ckpt-dir", str(ckpt), "--ckpt-every", "1",
+            timeout=420,
+            env_extra={
+                "TA_FAULT_STEP": "2",
+                "TA_FAULT_RANK": "1",
+                "TA_FAULT_ONCE_FILE": str(once),
+            },
+        )
+        assert record["mode"] == "train"
+        # A restart COMPLETES the original 3-step budget: the resumed
+        # attempt reports only the remaining steps (1 or 2, depending on
+        # whether the async step-1 save committed before the crash) — not
+        # another full --steps run.
+        assert 1 <= len(record["losses"]) <= 2, record["losses"]
+        assert not once.exists(), "fault never fired"
+        assert "resumed from step" in logs
+        assert "recovered after 2 attempt" in logs
+        # The budget's final step (2) is checkpointed — the job finished.
+        steps = [
+            int(d) for d in os.listdir(ckpt) if d.isdigit()
+        ]
+        assert 2 in steps, steps
 
     def test_train_host_data_pipeline(self):
         record, logs = run_cli(

@@ -341,3 +341,96 @@ class TestHeartbeatWatchdog:
         assert elapsed < 30, f"took {elapsed:.1f}s"
         assert statuses[0] == 7
         assert statuses[1] in (128 + 15, 128 + 9), statuses
+
+
+class TestElasticLaunch:
+    """Bounded whole-gang restart (elastic recovery). A consumable fault
+    marker makes the gang fail exactly once, so a green result proves
+    *recovery* (relaunch + clean completion), not retry-until-lucky; the
+    reference has no recovery story at all (a crashed rank hangs its peers'
+    allreduce forever, model.py:108,163)."""
+
+    def _flaky_cmd(self, marker):
+        # Rank 1 crashes (status 86) iff the marker exists, consuming it;
+        # every other rank — and every later attempt — exits clean.
+        code = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if int(os.environ['JAX_PROCESS_INDEX']) == 1:\n"
+            "    try:\n"
+            "        os.unlink(m)\n"
+            "    except FileNotFoundError:\n"
+            "        sys.exit(0)\n"
+            "    sys.exit(86)\n"
+            "sys.exit(0)\n"
+        )
+        return [sys.executable, "-c", code]
+
+    def test_restart_recovers(self, tmp_path):
+        marker = tmp_path / "fault_once"
+        marker.write_text("")
+        failures, statuses = hr.launch_local(
+            self._flaky_cmd(marker), 2, restarts=1, grace=0.5
+        )
+        assert failures == 0 and statuses == [0, 0]
+        assert hr.last_launch_attempts() == 2
+        assert not marker.exists()
+
+    def test_restarts_exhausted_reports_last_attempt(self):
+        failures, statuses = hr.launch_local(
+            [sys.executable, "-c", "raise SystemExit(7)"], 2,
+            restarts=2, grace=0.5,
+        )
+        assert failures > 0
+        assert hr.last_launch_attempts() == 3
+        assert 7 in statuses
+
+    def test_python_fallback_restart(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(hr, "load_native", lambda: None)
+        marker = tmp_path / "fault_once"
+        marker.write_text("")
+        failures, statuses = hr.launch_local(
+            self._flaky_cmd(marker), 2, restarts=1, grace=0.5
+        )
+        assert failures == 0 and statuses == [0, 0]
+        assert hr.last_launch_attempts() == 2
+
+    def test_zero_restarts_is_single_attempt(self):
+        failures, _ = hr.launch_local(
+            [sys.executable, "-c", "raise SystemExit(5)"], 1
+        )
+        assert failures == 1
+        assert hr.last_launch_attempts() == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="restarts"):
+            hr.launch_local(["true"], 1, restarts=-1)
+        with pytest.raises(ValueError, match="restarts"):
+            hr.launch_local(["true"], 1, restarts=1, failfast=False)
+
+    def test_fault_injection_consumable(self, monkeypatch, tmp_path):
+        # maybe_inject_fault: rank 1 dies at "step 0" on the first attempt
+        # only (the once-file is consumed); the restarted gang completes.
+        once = tmp_path / "once"
+        once.write_text("")
+        monkeypatch.setenv("TA_FAULT_STEP", "0")
+        monkeypatch.setenv("TA_FAULT_RANK", "1")
+        monkeypatch.setenv("TA_FAULT_ONCE_FILE", str(once))
+        code = (
+            "from tree_attention_tpu.host_runtime import maybe_inject_fault\n"
+            "maybe_inject_fault(0)\n"
+        )
+        failures, statuses = hr.launch_local(
+            [sys.executable, "-c", code], 2, restarts=1, grace=0.5
+        )
+        assert failures == 0 and statuses == [0, 0]
+        assert hr.last_launch_attempts() == 2
+        assert not once.exists()
+
+    def test_fault_injection_disarmed_is_noop(self, monkeypatch):
+        monkeypatch.delenv("TA_FAULT_STEP", raising=False)
+        hr.maybe_inject_fault(0)  # must not raise or exit
+        monkeypatch.setenv("TA_FAULT_STEP", "3")
+        monkeypatch.setenv("TA_FAULT_RANK", "0")
+        monkeypatch.setenv("JAX_PROCESS_INDEX", "1")
+        hr.maybe_inject_fault(3)  # wrong rank: no-op

@@ -27,6 +27,6 @@ Public API highlights:
   on the above.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from tree_attention_tpu.ops import flash_attention, merge_partials  # noqa: F401

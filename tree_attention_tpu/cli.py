@@ -60,30 +60,51 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
         if skip:
             skip = False
             continue
-        parent_only = ("--launch", "--launch-timeout", "--heartbeat-stall")
+        parent_only = (
+            "--launch", "--launch-timeout", "--heartbeat-stall", "--restarts"
+        )
         if a in parent_only:
             skip = True
             continue
         if a.startswith(tuple(f + "=" for f in parent_only)):
             continue
         child_args.append(a)
+    elastic_resume = bool(cfg.restarts and cfg.ckpt_dir)
+    if elastic_resume and "--resume" not in child_args:
+        # Elastic restart is only a *resume* if the children restore their
+        # latest checkpoint; an empty --ckpt-dir makes --resume a fresh
+        # start, so adding it unconditionally is safe.
+        child_args.append("--resume")
     cmd = [sys.executable, "-m", "tree_attention_tpu", *child_args]
     log.info("launching %d coordinated processes: %s", cfg.launch, cmd)
     # The coordinator address travels to the children via inherited env;
     # restore the parent's env afterwards so a later in-process run doesn't
     # find a stale coordinator.
-    prev = os.environ.get("TA_COORDINATOR")
+    prev = {
+        k: os.environ.get(k) for k in ("TA_COORDINATOR", "TA_TRAIN_TOTAL_STEPS")
+    }
     os.environ["TA_COORDINATOR"] = f"localhost:{_pick_free_port()}"
+    if elastic_resume and cfg.mode == "train":
+        # A restarted child must COMPLETE the original budget, not run
+        # --steps more from its restored point (_run_train reads this).
+        os.environ["TA_TRAIN_TOTAL_STEPS"] = str(cfg.steps)
     try:
         failures, statuses = launch_local(
             cmd, cfg.launch, timeout=cfg.launch_timeout,
-            heartbeat_stall=cfg.heartbeat_stall,
+            heartbeat_stall=cfg.heartbeat_stall, restarts=cfg.restarts,
         )
     finally:
-        if prev is None:
-            del os.environ["TA_COORDINATOR"]
-        else:
-            os.environ["TA_COORDINATOR"] = prev
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if cfg.restarts and not failures:
+        from tree_attention_tpu.host_runtime import last_launch_attempts
+
+        attempts = last_launch_attempts()
+        if attempts > 1:
+            log.warning("launch: recovered after %d attempt(s)", attempts)
     if failures:
         log.error("launch: %d/%d ranks failed: %s", failures, cfg.launch,
                   statuses)
@@ -237,6 +258,15 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             state, start_step = ckpt.restore(state)
             log.info("resumed from step %d", start_step)
     start = 0 if start_step is None else start_step + 1
+    # Plain --resume keeps its documented continuation semantics: run
+    # --steps MORE steps from the restored point. An elastic restart
+    # (--launch --restarts) instead completes the ORIGINAL budget — a
+    # restart is a resume, not a redo — so the parent threads the absolute
+    # target through the environment alongside the rank protocol.
+    end = start + cfg.steps
+    total = os.environ.get("TA_TRAIN_TOTAL_STEPS")
+    if total is not None:
+        end = max(int(total), start)
     key = jax.random.PRNGKey(cfg.seed + 1)
     pipe = None
     corpus = None
@@ -292,9 +322,10 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     losses = []
     saved_last = True
     try:
-        from tree_attention_tpu.host_runtime import heartbeat
+        from tree_attention_tpu.host_runtime import heartbeat, maybe_inject_fault
 
-        for i in range(start, start + cfg.steps):
+        for i in range(start, end):
+            maybe_inject_fault(i)  # env-armed test crash (supervision/elastic)
             batch = next_batch(i)
             state, loss = step(state, batch)
             losses.append(float(loss))
@@ -302,10 +333,10 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             log.info("step %d: loss %.4f", i, losses[-1])
             if ckpt is not None:
                 saved_last = ckpt.save(i, state, cfg=tcfg)
-        if ckpt is not None and not saved_last:
+        if ckpt is not None and not saved_last and end > start:
             # The save interval skipped the final step; the resumable state
             # must include all completed work.
-            ckpt.save(start + cfg.steps - 1, state, cfg=tcfg, force=True)
+            ckpt.save(end - 1, state, cfg=tcfg, force=True)
     finally:
         if pipe is not None:
             pipe.close()
@@ -316,6 +347,11 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     # Throughput of the compiled step (last batch, post-compile). Timing
     # re-runs with the same state, so a donating step can't be reused —
     # with --ckpt-dir the step is already non-donating.
+    if end == start:
+        # Restarted after the budget was already complete: nothing trained
+        # this attempt (losses is empty), but the record still needs a batch
+        # to time the compiled step against.
+        batch = next_batch(start)
     step_t = step if cfg.ckpt_dir else make_train_step(
         tcfg, opt, mesh=mesh, donate=False
     )

@@ -124,6 +124,16 @@ def load_native() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int),
             ]
+        if hasattr(lib, "ta_launch_processes_elastic"):
+            lib.ta_launch_processes_elastic.restype = ctypes.c_int
+            lib.ta_launch_processes_elastic.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
         if hasattr(lib, "ta_corpus_open"):
             lib.ta_corpus_open.restype = ctypes.c_void_p
             lib.ta_corpus_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -449,6 +459,49 @@ def heartbeat() -> None:
         pass  # never let observability kill the workload
 
 
+def last_launch_attempts() -> int:
+    """Attempts used by the most recent :func:`launch_local` in this process
+    (1 = no restart was needed). Observability for the elastic path."""
+    return _LAST_LAUNCH["attempts"]
+
+
+_LAST_LAUNCH = {"attempts": 1}
+
+
+def maybe_inject_fault(step: int) -> None:
+    """Fault injection for exercising the supervision/recovery machinery
+    (SURVEY §5: the reference has no failure handling at all — a crashed
+    rank hangs its peers' allreduce forever).
+
+    Armed by environment, so production runs pay one getenv per step:
+
+    - ``TA_FAULT_STEP`` (int): the step index at which to die; unset = off.
+    - ``TA_FAULT_RANK`` (int, default 0): which rank dies.
+    - ``TA_FAULT_ONCE_FILE`` (path, optional): the fault fires only if this
+      file exists, and consumes (unlinks) it when it does — so a restarted
+      gang does NOT re-crash. This turns an elastic-recovery test into a
+      proof of *recovery* (resume + complete) rather than retry-until-luck.
+
+    Dies via ``os._exit(86)`` — no atexit, no JAX teardown — the honest
+    shape of a real crash. 86 is distinct from the supervisor's other
+    statuses (124 deadline, 125 stall, 128+sig).
+    """
+    spec = os.environ.get("TA_FAULT_STEP")
+    if spec is None or step != int(spec):
+        return
+    rank = int(os.environ.get("TA_FAULT_RANK", "0"))
+    if int(os.environ.get("JAX_PROCESS_INDEX", "0")) != rank:
+        return
+    once = os.environ.get("TA_FAULT_ONCE_FILE")
+    if once:
+        try:
+            os.unlink(once)
+        except FileNotFoundError:
+            return  # already fired on a previous attempt
+    log.error("fault injection: rank %d exiting at step %d", rank, step)
+    os._exit(86)
+
+
 def launch_local(
     argv: Sequence[str],
     nprocs: int,
@@ -457,6 +510,7 @@ def launch_local(
     grace: float = 2.0,
     failfast: bool = True,
     heartbeat_stall: Optional[float] = None,
+    restarts: int = 0,
 ) -> Tuple[int, List[int]]:
     """Run ``nprocs`` copies of ``argv``, each with ``JAX_PROCESS_INDEX`` /
     ``TA_NUM_PROCESSES`` exported; returns (failure_count, per-rank statuses).
@@ -481,11 +535,27 @@ def launch_local(
     window — counted from launch until its first beat, so size it for jit
     compile — gets the job killed, stalled ranks reporting status **125**
     (vs 124 deadline, 128+sig crash). Requires ``failfast``.
+
+    ``restarts`` arms **elastic recovery**: after a failed attempt (rank
+    crash, deadline, heartbeat stall) the whole gang is relaunched with the
+    same argv, up to ``restarts`` additional attempts. Whole-gang restart is
+    the right granularity for SPMD — a surviving rank is wedged in a
+    collective the moment any peer dies, so there is nothing to rejoin. The
+    workload must be *resumable*: restore its latest checkpoint on start
+    (the CLI train mode's ``--resume`` contract), making a restart a resume
+    rather than a redo. ``timeout`` is per attempt. Requires ``failfast``;
+    :func:`last_launch_attempts` reports how many attempts the last call
+    used. The reference has no recovery story at all — a crashed rank hangs
+    its peers' allreduce forever (``model.py:108,163``).
     """
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
     if not failfast and timeout:
         raise ValueError("timeout requires failfast=True")
+    if restarts < 0:
+        raise ValueError(f"restarts must be >= 0, got {restarts}")
+    if restarts and not failfast:
+        raise ValueError("restarts requires failfast=True")
     if heartbeat_stall is not None:
         if not failfast:
             raise ValueError("heartbeat_stall requires failfast=True")
@@ -496,13 +566,78 @@ def launch_local(
     hb_dir = None
     if heartbeat_stall is not None:
         hb_dir = tempfile.mkdtemp(prefix="ta_hb_")
+    _LAST_LAUNCH["attempts"] = 1
     try:
-        return _launch_local_impl(
-            argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir
+        return _launch_elastic(
+            argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir,
+            restarts,
         )
     finally:
         if hb_dir is not None:
             shutil.rmtree(hb_dir, ignore_errors=True)
+
+
+def _native_launch_args(argv, nprocs, timeout, grace, heartbeat_stall):
+    """ctypes marshalling shared by every native launch entry — one home,
+    so conventions (timeout 0 = no deadline, ms floors) cannot diverge
+    between the single-attempt and elastic paths."""
+    c_argv = (ctypes.c_char_p * (len(argv) + 1))(
+        *[a.encode() for a in argv], None
+    )
+    statuses = (ctypes.c_int * nprocs)()
+    timeout_ms = 0 if not timeout else max(1, int(timeout * 1000))
+    grace_ms = max(1, int(grace * 1000))
+    hb_ms = (
+        0 if heartbeat_stall is None else max(1, int(heartbeat_stall * 1000))
+    )
+    return c_argv, statuses, timeout_ms, grace_ms, hb_ms
+
+
+def _launch_elastic(
+    argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir, restarts
+) -> Tuple[int, List[int]]:
+    """Dispatch the (possibly restarted) gang launch.
+
+    The native elastic entry runs the whole restart loop in C++; hosts
+    without it (or the subprocess fallback) retry in Python around the
+    single-attempt impl — same semantics, same per-attempt deadline.
+    """
+    lib = load_native()
+    if (
+        restarts
+        and lib is not None
+        and hasattr(lib, "ta_launch_processes_elastic")
+    ):
+        c_argv, statuses, timeout_ms, grace_ms, hb_ms = _native_launch_args(
+            argv, nprocs, timeout, grace, heartbeat_stall
+        )
+        attempts = ctypes.c_int(1)
+        failures = lib.ta_launch_processes_elastic(
+            c_argv, nprocs, timeout_ms, grace_ms,
+            hb_dir.encode() if hb_dir is not None else None,
+            hb_ms, restarts, statuses, ctypes.byref(attempts),
+        )
+        if failures < 0:
+            raise OSError("fork failed in the native launcher")
+        _LAST_LAUNCH["attempts"] = attempts.value
+        if attempts.value > 1:
+            log.warning(
+                "gang restarted: %d attempt(s), final statuses %s",
+                attempts.value, list(statuses),
+            )
+        return failures, list(statuses)
+    for attempt in range(1, restarts + 2):
+        _LAST_LAUNCH["attempts"] = attempt
+        failures, statuses = _launch_local_impl(
+            argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir
+        )
+        if failures == 0 or attempt > restarts:
+            return failures, statuses
+        log.warning(
+            "gang attempt %d/%d failed (statuses %s); restarting",
+            attempt, restarts + 1, statuses,
+        )
+    raise AssertionError("unreachable")
 
 
 def _launch_local_impl(
@@ -512,26 +647,18 @@ def _launch_local_impl(
     if lib is not None and (
         heartbeat_stall is None or hasattr(lib, "ta_launch_processes_watched")
     ):
-        c_argv = (ctypes.c_char_p * (len(argv) + 1))(
-            *[a.encode() for a in argv], None
+        c_argv, statuses, timeout_ms, grace_ms, hb_ms = _native_launch_args(
+            argv, nprocs, timeout, grace, heartbeat_stall
         )
-        statuses = (ctypes.c_int * nprocs)()
         if heartbeat_stall is not None:
             failures = lib.ta_launch_processes_watched(
-                c_argv, nprocs,
-                0 if not timeout else max(1, int(timeout * 1000)),
-                max(1, int(grace * 1000)),
-                hb_dir.encode(),
-                max(1, int(heartbeat_stall * 1000)),
+                c_argv, nprocs, timeout_ms, grace_ms, hb_dir.encode(), hb_ms,
                 statuses,
             )
         elif failfast:
             # timeout in (None, 0) = no deadline, the timeout(1) convention.
             failures = lib.ta_launch_processes_supervised(
-                c_argv, nprocs,
-                0 if not timeout else max(1, int(timeout * 1000)),
-                max(1, int(grace * 1000)),
-                statuses,
+                c_argv, nprocs, timeout_ms, grace_ms, statuses,
             )
         else:
             failures = lib.ta_launch_processes(c_argv, nprocs, statuses)

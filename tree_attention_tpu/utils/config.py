@@ -55,6 +55,7 @@ class RunConfig:
     launch: int = 0  # >1: respawn N coordinated processes (multi-host shape)
     launch_timeout: Optional[float] = None  # seconds; kill all ranks at expiry
     heartbeat_stall: Optional[float] = None  # seconds; hang watchdog window
+    restarts: int = 0  # elastic: whole-gang relaunches after a failure
     impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
     block_size: Optional[int] = None  # None -> impl-appropriate default
     kv_quant: str = "none"  # none | int8 (decode/generate: quantized KV)
@@ -135,6 +136,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    "stalled ranks reporting status 125 — catches the "
                    "all-ranks-alive collective deadlock the fail-fast "
                    "supervisor cannot see. Size it for jit compile time.")
+    p.add_argument("--restarts", type=int, default=d.restarts, metavar="K",
+                   help="elastic recovery for --launch: after a failed "
+                   "attempt (crash/deadline/stall) relaunch the whole gang "
+                   "up to K more times; with --ckpt-dir the children resume "
+                   "from the latest checkpoint, so a restart is a resume, "
+                   "not a redo")
     p.add_argument("--batch", type=int, default=d.batch)
     p.add_argument("--seq-len", type=int, default=d.seq_len)
     p.add_argument("--q-len", type=int, default=d.q_len)
