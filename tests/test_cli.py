@@ -284,6 +284,32 @@ class TestCLI:
         assert "corpus pipeline" in logs
         assert len(record["losses"]) == 2 and all(l > 0 for l in record["losses"])
 
+    def test_restart_with_completed_budget_and_corpus(self, tmp_path):
+        # An elastic restart can land AFTER the budget's final checkpoint
+        # committed (crash between the last save and the record emit). The
+        # resumed attempt then trains zero steps but must still emit a
+        # record — including on the --data corpus path, where the timing
+        # batch must be fetched before the pipeline/corpus close
+        # (regression: it was fetched after, crashing on the closed mmap).
+        import numpy as np
+
+        corpus = tmp_path / "toks.bin"
+        (np.arange(4096, dtype="<i4") % 64).tofile(str(corpus))
+        args = [
+            "--mode", "train", "--device", "cpu", "--seq-len", "32",
+            "--model-dim", "32", "--heads", "2", "--head-dim", "16",
+            "--vocab-size", "64", "--steps", "2", "--batch", "1",
+            "--dtype", "float32", "--iters", "1", "--data", str(corpus),
+            "--ckpt-dir", str(tmp_path / "ckpt"), "--ckpt-every", "1",
+        ]
+        run_cli(*args)
+        record, _ = run_cli(
+            *args, "--resume", env_extra={"TA_TRAIN_TOTAL_STEPS": "2"}
+        )
+        assert record["mode"] == "train"
+        assert record["losses"] == []  # budget already complete
+        assert record["tokens_per_sec"] > 0  # timing batch still produced
+
     def test_log_file_flag(self, tmp_path):
         log = tmp_path / "cli.log"
         run_cli(*TINY, "--log-file", str(log))

@@ -69,12 +69,28 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
         if a.startswith(tuple(f + "=" for f in parent_only)):
             continue
         child_args.append(a)
-    elastic_resume = bool(cfg.restarts and cfg.ckpt_dir)
-    if elastic_resume and "--resume" not in child_args:
-        # Elastic restart is only a *resume* if the children restore their
-        # latest checkpoint; an empty --ckpt-dir makes --resume a fresh
-        # start, so adding it unconditionally is safe.
+    # Elastic restart is only a *resume* if the children restore their
+    # latest checkpoint. When the user did NOT pass --resume themselves,
+    # the parent adds it and threads the absolute step target, so a
+    # restarted child COMPLETES the original --steps budget (an empty
+    # --ckpt-dir makes --resume a fresh start, so adding it is safe —
+    # though note a --restarts run against a ckpt-dir with prior state
+    # declares that state resumable and will continue it). When the user
+    # passed --resume explicitly, its documented continuation contract
+    # ("run --steps MORE") is kept: each restart attempt then runs --steps
+    # from its own restore point, so a crash can extend the total run —
+    # bounded, since checkpoints only move forward.
+    elastic_resume = bool(
+        cfg.restarts and cfg.ckpt_dir and "--resume" not in child_args
+    )
+    if elastic_resume:
         child_args.append("--resume")
+    elif cfg.restarts and cfg.ckpt_dir:
+        log.warning(
+            "--restarts with explicit --resume keeps continuation "
+            "semantics: each restart runs --steps more from its restore "
+            "point rather than completing one fixed budget"
+        )
     cmd = [sys.executable, "-m", "tree_attention_tpu", *child_args]
     log.info("launching %d coordinated processes: %s", cfg.launch, cmd)
     # The coordinator address travels to the children via inherited env;
@@ -337,6 +353,12 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             # The save interval skipped the final step; the resumable state
             # must include all completed work.
             ckpt.save(end - 1, state, cfg=tcfg, force=True)
+        if end == start:
+            # Restarted after the budget was already complete: nothing to
+            # train this attempt (losses stays empty), but the record still
+            # needs a batch to time the compiled step against — fetched
+            # here, while the data pipeline/corpus are still open.
+            batch = next_batch(start)
     finally:
         if pipe is not None:
             pipe.close()
@@ -347,11 +369,6 @@ def _run_train(cfg: RunConfig, mesh) -> int:
     # Throughput of the compiled step (last batch, post-compile). Timing
     # re-runs with the same state, so a donating step can't be reused —
     # with --ckpt-dir the step is already non-donating.
-    if end == start:
-        # Restarted after the budget was already complete: nothing trained
-        # this attempt (losses is empty), but the record still needs a batch
-        # to time the compiled step against.
-        batch = next_batch(start)
     step_t = step if cfg.ckpt_dir else make_train_step(
         tcfg, opt, mesh=mesh, donate=False
     )

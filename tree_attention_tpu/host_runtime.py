@@ -619,12 +619,12 @@ def _launch_elastic(
         )
         if failures < 0:
             raise OSError("fork failed in the native launcher")
+        # No summary log here: last_launch_attempts() is the API and the
+        # CLI owns the one "recovered after N attempts" message, so native
+        # and fallback paths log the same shape. (The fallback additionally
+        # logs each failed attempt as it happens — per-attempt visibility
+        # the C++ loop cannot provide.)
         _LAST_LAUNCH["attempts"] = attempts.value
-        if attempts.value > 1:
-            log.warning(
-                "gang restarted: %d attempt(s), final statuses %s",
-                attempts.value, list(statuses),
-            )
         return failures, list(statuses)
     for attempt in range(1, restarts + 2):
         _LAST_LAUNCH["attempts"] = attempt

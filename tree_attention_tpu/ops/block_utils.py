@@ -70,17 +70,21 @@ def split_kv_blocks(
 def tile_geometry(qi, ki, block_q: int, block_k: int, q_offset, kv_offset):
     """Per-tile global positions for the Pallas kernels (rows = Q, cols = K).
 
-    Returns ``(row_pos, col_idx, col_pos)`` of shape (block_q, block_k):
-    global query positions, local key column indices (for the ragged-tail
-    check against Tk), and global key positions. Forward and both backward
-    kernels must use this one definition or their masks diverge.
+    Returns ``(row_pos, col_idx, col_pos)`` in **broadcast form** —
+    ``row_pos`` is ``(block_q, 1)``, ``col_idx``/``col_pos`` are
+    ``(1, block_k)`` — so a mask like ``row_pos >= col_pos`` materialises
+    one ``(block_q, block_k)`` compare instead of two full-tile i32 iotas
+    first (~4 VPU passes down to ~1; measured 2026-07-31, the full-tile
+    form cost the 4k causal fwd kernel several percent and an attempted
+    ``lax.cond`` skip cost 45%). Forward and both backward kernels must use
+    this one definition or their masks diverge.
     """
     q_start = qi * block_q
     k_start = ki * block_k
     row_pos = q_offset + q_start + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+        jnp.int32, (block_q, 1), 0
     )
-    col_idx = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    col_idx = k_start + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
     col_pos = kv_offset + col_idx
     return row_pos, col_idx, col_pos
 
@@ -92,6 +96,33 @@ def tile_live(qi, ki, block_q: int, block_k: int, q_offset, kv_offset,
     if not causal:
         return True
     return (q_offset + qi * block_q + block_q - 1) >= (kv_offset + ki * block_k)
+
+
+def mask_scores(s, qi, ki, block_q: int, block_k: int, q_offset, kv_offset,
+                tk: int, causal: bool):
+    """Ragged-tail + causal masking for a ``(block_q, block_k)`` score tile.
+
+    Static no-op for non-causal divisible shapes. Built from the broadcast
+    geometry (see :func:`tile_geometry`): the mask is one broadcast compare
+    + select, not full-tile iota materialisation. (A ``lax.cond``
+    interior-tile skip was tried and REGRESSED the 4k causal fwd kernel 45%
+    on v5e — Mosaic's vector-operand branch join costs more than the mask
+    it saves — and VMEM-OOM'd the bwd kernels at 16k; don't reintroduce
+    it.) One definition shared by the fwd and both bwd kernels.
+    """
+    needs_ragged = tk % block_k != 0
+    if not causal and not needs_ragged:
+        return s
+    row_pos, col_idx, col_pos = tile_geometry(
+        qi, ki, block_q, block_k, q_offset, kv_offset
+    )
+    if needs_ragged and causal:
+        valid = (col_idx < tk) & (row_pos >= col_pos)
+    elif causal:
+        valid = row_pos >= col_pos
+    else:
+        valid = jnp.broadcast_to(col_idx < tk, s.shape)
+    return jnp.where(valid, s, NEG_INF)
 
 
 def static_offsets(q_offset, kv_offset) -> bool:

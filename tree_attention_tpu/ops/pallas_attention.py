@@ -36,9 +36,9 @@ from tree_attention_tpu.ops.block_utils import (
     LANES as _LANES,
     NEG_INF,
     culled_ki,
+    mask_scores,
     matmul_precision,
     static_offsets,
-    tile_geometry,
     tile_live,
 )
 
@@ -74,10 +74,6 @@ def _flash_fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    row_pos, col_idx, col_pos = tile_geometry(
-        qi, ki, block_q, block_k, q_offset, kv_offset
-    )
-
     @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _compute():
         # Operands stay in their native dtype (bf16 hits the MXU's fast
@@ -91,10 +87,10 @@ def _flash_fwd_kernel(
             precision=matmul_precision(q_ref.dtype, k_ref.dtype),
         ) * scale  # (bq, bk) f32
 
-        valid = col_idx < tk  # drop the ragged last KV block's garbage cols
-        if causal:
-            valid = valid & (row_pos >= col_pos)
-        s = jnp.where(valid, s, NEG_INF)
+        # Ragged-tail + causal masking; interior tiles skip it entirely.
+        s = mask_scores(
+            s, qi, ki, block_q, block_k, q_offset, kv_offset, tk, causal
+        )
 
         m_prev = m_scr[:, :1]  # (bq, 1)
         l_prev = l_scr[:, :1]

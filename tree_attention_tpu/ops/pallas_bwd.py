@@ -37,9 +37,9 @@ from jax.experimental.pallas import tpu as pltpu
 from tree_attention_tpu.ops.block_utils import (
     culled_ki,
     culled_qi,
+    mask_scores,
     pad_to_block,
     static_offsets,
-    tile_geometry,
     tile_live,
 )
 
@@ -54,7 +54,7 @@ DELTA_LANE = 64  # lane carrying delta in the packed residual (lse rides 0)
 
 
 def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
-                    row_pos, col_idx, col_pos, tk):
+                    qi, ki, block_q, block_k, q_offset, kv_offset, tk):
     """p and ds for one (Q-tile, KV-tile) pair, f32 results.
 
     Matmul operands stay in their storage dtype (bf16 rides the MXU fast
@@ -65,10 +65,11 @@ def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
         preferred_element_type=jnp.float32,
         precision=matmul_precision(q.dtype, k.dtype),
     ) * scale
-    valid = col_idx < tk
-    if causal:
-        valid = valid & (row_pos >= col_pos)
-    s = jnp.where(valid, s, NEG_INF)
+    # Ragged-tail + causal masking; interior tiles skip it entirely — the
+    # backward pays the mask in BOTH kernels per tile pair, so the interior
+    # fast path saves twice what it saves the forward.
+    s = mask_scores(s, qi, ki, block_q, block_k, q_offset, kv_offset, tk,
+                    causal)
     # lse is padded with +inf on padded rows -> p == 0 there; masked cols give
     # exp(-inf - lse) == 0.
     p = jnp.exp(s - lse)
@@ -91,18 +92,15 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
     def _():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    row_pos, col_idx, col_pos = tile_geometry(
-        qi, ki, block_q, block_k, q_offset, kv_offset
-    )
-
     @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _():
         _, ds = _recompute_p_ds(
             q_ref[0], k_ref[0], v_ref[0],
             do_ref[0], res_ref[0][:, :1],
             res_ref[0][:, DELTA_LANE:DELTA_LANE + 1],
-            scale=scale, causal=causal,
-            row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
+            scale=scale, causal=causal, qi=qi, ki=ki,
+            block_q=block_q, block_k=block_k,
+            q_offset=q_offset, kv_offset=kv_offset, tk=tk,
         )
         dq_scr[...] += lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -131,18 +129,15 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
     # gq enumerates (g, qi) pairs — same decoding as the BlockSpec index maps.
     qi = gq % n_q
 
-    row_pos, col_idx, col_pos = tile_geometry(
-        qi, ki, block_q, block_k, q_offset, kv_offset
-    )
-
     @pl.when(tile_live(qi, ki, block_q, block_k, q_offset, kv_offset, causal))
     def _():
         p, ds = _recompute_p_ds(
             q_ref[0], k_ref[0], v_ref[0],
             do_ref[0], res_ref[0][:, :1],
             res_ref[0][:, DELTA_LANE:DELTA_LANE + 1],
-            scale=scale, causal=causal,
-            row_pos=row_pos, col_idx=col_idx, col_pos=col_pos, tk=tk,
+            scale=scale, causal=causal, qi=qi, ki=ki,
+            block_q=block_q, block_k=block_k,
+            q_offset=q_offset, kv_offset=kv_offset, tk=tk,
         )
         dk_scr[...] += lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0],

@@ -112,15 +112,24 @@ def _flash_decode_kernel(
         # Visibility: lane i is KV global position kv_offset + si*bk + i;
         # sublane j is query row ((qi*bq + j) % Tq) at global position
         # q_offset + that. Padded rows (j >= r) alias a real query's position
-        # and compute a duplicate row the host slices away.
-        col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        valid = col_idx < tk
-        if causal:
-            q_pos = q_offset + (
-                (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)) % tq
-            )
-            valid &= (kv_offset + col_idx) <= q_pos
-        s = jnp.where(valid, s, NEG_INF)
+        # and compute a duplicate row the host slices away. Broadcast-form
+        # mask: (bq, 1) row positions vs (1, bk) column positions — one
+        # broadcast compare, no full-tile iota materialisation (see
+        # block_utils.mask_scores for why not a lax.cond interior skip).
+        needs_ragged = tk % bk != 0
+        if causal or needs_ragged:
+            col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+            valid = None
+            if needs_ragged:
+                valid = col_idx < tk
+            if causal:
+                q_pos = q_offset + (
+                    (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+                    % tq
+                )
+                c = (kv_offset + col_idx) <= q_pos
+                valid = c if valid is None else valid & c
+            s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # (bq, 1)
         l_prev = l_scr[:, :1]
