@@ -65,9 +65,8 @@ def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
         preferred_element_type=jnp.float32,
         precision=matmul_precision(q.dtype, k.dtype),
     ) * scale
-    # Ragged-tail + causal masking; interior tiles skip it entirely — the
-    # backward pays the mask in BOTH kernels per tile pair, so the interior
-    # fast path saves twice what it saves the forward.
+    # Ragged-tail + causal masking (broadcast-form; the backward pays the
+    # mask in BOTH kernels per tile pair, so its cost matters double here).
     s = mask_scores(s, qi, ki, block_q, block_k, q_offset, kv_offset, tk,
                     causal)
     # lse is padded with +inf on padded rows -> p == 0 there; masked cols give
