@@ -14,16 +14,21 @@ Workloads (VERDICT round-1 item 5 — one driver-parseable record):
   through the Pallas kernels at seq 4096: TFLOP/s and MFU vs the v5e bf16
   peak, with FLOPs counted from the kernels' live-tile launches.
 - ``train_fwd_bwd_16k`` — the same at seq 16384 (BASELINE config 2's shape).
-- ``tree_vs_ring``    — tree- vs ring-attention step time on an emulated
-  8-way sequence mesh (clean subprocess, CPU backend; the BASELINE.json
-  north-star ratio's shape). Read it as a correctness/latency-shape check,
-  NOT the north star: the emulation timeshares every "device" on the same
-  cores, so wall clock tracks *total* FLOPs across shards and tree's
-  log-depth collective advantage over ICI cannot appear. Since the
-  per-run causal dispatch landed (r3), both algorithms cull to the same
-  live T²/2 on every impl, so parity (~1.0×) is the expected emulated
-  reading; the remaining tree-side costs are its merge collectives, which
-  the emulation prices at memcpy cost rather than wire cost.
+- ``tree_vs_ring``    — tree- vs ring- (and zigzag-tree / Ulysses-)
+  attention step time on an emulated 8-way sequence mesh (clean
+  subprocess, CPU backend; the BASELINE.json north-star ratio's shape).
+  Read it as a correctness/latency-shape check, NOT the north star: the
+  emulation timeshares every "device" on the same cores, so wall clock
+  tracks *total* FLOPs across shards and tree's log-depth collective
+  advantage over ICI cannot appear. Since the per-run causal dispatch
+  landed (r3), both algorithms cull to the same live T²/2 on every impl,
+  so parity (~1.0×) is the expected emulated reading; the remaining
+  tree-side costs are its merge collectives, which the emulation prices at
+  memcpy cost rather than wire cost. The Ulysses entry reads LOW here for
+  the same reason, amplified: its two all-to-alls move Q+K+V+O at full
+  size (vs ring's KV-only rotation), and the emulation charges that as
+  host memcpy with none of the ICI bisection bandwidth the family is
+  designed around.
 
 Measurement protocol (motivated by the tunneled-TPU transport this runs on,
 where ``block_until_ready`` can resolve before execution finishes and a host
@@ -283,11 +288,14 @@ def _tree_vs_ring_record():
         env["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count=8".strip()
         )
+    # heads=8 (divisible by the 8-way mesh) lets the Ulysses family join
+    # the same record; per-head FLOPs halve via head_dim to keep the
+    # record's runtime in its old envelope.
     proc = subprocess.run(
         [sys.executable, "-m", "tree_attention_tpu", "--mode", "bench",
          "--comparator", "ring", "--device", "cpu", "--n-virtual-cpu", "8",
          "--mesh", "seq=8", "--seq-len", "4096", "--causal",
-         "--heads", "4", "--head-dim", "64", "--iters", "3",
+         "--heads", "8", "--head-dim", "32", "--iters", "3",
          "--dtype", "float32"],
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,

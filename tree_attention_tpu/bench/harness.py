@@ -32,6 +32,7 @@ from tree_attention_tpu.parallel.tree import (
     tree_decode,
     tree_decode_q8,
 )
+from tree_attention_tpu.parallel.ulysses import ulysses_attention
 from tree_attention_tpu.utils.config import RunConfig
 from tree_attention_tpu.utils.logging import get_logger
 from tree_attention_tpu.utils.profiling import TimingStats, device_memory_stats, time_fn
@@ -221,7 +222,11 @@ def _train_shape_fn(
         # pairs are causally live, not what the bytes are.
         attn, extra = tree_attention, {"layout": "zigzag"}
     else:
-        attn = {"tree": tree_attention, "ring": ring_attention}[algorithm]
+        attn = {
+            "tree": tree_attention,
+            "ring": ring_attention,
+            "ulysses": ulysses_attention,
+        }[algorithm]
 
     def loss(q, k, v):
         out, _ = attn(
@@ -296,6 +301,21 @@ def bench_compare(cfg: RunConfig, mesh: Mesh) -> Dict[str, Any]:
         record["tree_zigzag_speedup_vs_ring"] = round(
             ring.timing.median / zz.timing.median, 3
         )
+    # The third SP family joins the comparison when its head-divisibility
+    # requirement holds (it re-shards the PER-SHARD head slice, so a model
+    # axis divides the head count first; see parallel/ulysses). Guarded like
+    # zigzag above: an inapplicable config must never lose tree/ring's
+    # already-computed results.
+    h_shards = mesh.shape.get("model", 1)
+    hq_l, hkv_l = cfg.heads, cfg.resolved_kv_heads()
+    if hq_l % h_shards == 0 and hkv_l % h_shards == 0:
+        hq_l, hkv_l = hq_l // h_shards, hkv_l // h_shards
+        if hq_l % n == 0 and hkv_l % n == 0:
+            uly = bench_train_attention(cfg, mesh, "ulysses")
+            record["ulysses"] = uly.as_dict()
+            record["ulysses_speedup_vs_ring"] = round(
+                ring.timing.median / uly.timing.median, 3
+            )
     return record
 
 

@@ -12,6 +12,7 @@ from tree_attention_tpu.parallel.mesh import (  # noqa: F401
     shard_along,
 )
 from tree_attention_tpu.parallel.ring import ring_attention  # noqa: F401
+from tree_attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
 from tree_attention_tpu.parallel.tree import (  # noqa: F401
     shard_zigzag,
     tree_attention,
