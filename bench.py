@@ -125,20 +125,24 @@ def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
     return rec
 
 
-def _decode_q8_record(H, Hkv, T, n_small, n_large):
+def _decode_q8_record(H, Hkv, T, n_small, n_large, q_quant=False):
     """Decode over an int8-quantized KV buffer: the same slope protocol,
     half the KV bytes per step. tokens/sec is the headline gain; roofline-%
     is computed against the int8 byte count (the stream the chip actually
-    reads)."""
+    reads). ``q_quant=True`` times the int8-MXU variant (Q quantized per
+    row, int8 x int8 scores — no K dequant cast on the stream)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from tree_attention_tpu.ops.pallas_decode import (
         attention_pallas_decode_q8,
+        attention_pallas_decode_q8q,
         quantize_kv_channelwise,
     )
     from tree_attention_tpu.utils.profiling import time_per_step
+
+    attn = attention_pallas_decode_q8q if q_quant else attention_pallas_decode_q8
 
     D = 128
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -150,7 +154,7 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large):
     def mk(n):
         def f(q, k_q, v_q):
             def body(qc, _):
-                out, _ = attention_pallas_decode_q8(
+                out, _ = attn(
                     qc, k_q, v_q, k_s, v_s, causal=True, q_offset=T - 1
                 )
                 return out.astype(qc.dtype), None
@@ -168,7 +172,8 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large):
     return {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
                      "head_dim": D, "kv_dtype": "int8", "q_len": 1,
-                     "causal": True},
+                     "causal": True,
+                     "q_dtype": "int8(row)" if q_quant else "bfloat16"},
         "us_per_step": round(per_step * 1e6, 1),
         "kv_tokens_per_sec": round(T / per_step, 1),
         "hbm_bytes_per_sec": round(bw, 1),
@@ -356,8 +361,8 @@ _EVIDENCE_PATH = os.environ.get(
                  "bench_evidence.jsonl"),
 )
 _TPU_RECORDS = ("decode_64k", "decode_gqa_128k", "decode_gqa_1m",
-                "decode_mha_1m", "decode_64k_q8", "train_fwd_bwd",
-                "train_fwd_bwd_16k")
+                "decode_mha_1m", "decode_64k_q8", "decode_64k_q8q",
+                "train_fwd_bwd", "train_fwd_bwd_16k")
 
 
 def _save_evidence(suite) -> None:
@@ -467,6 +472,8 @@ def main() -> None:
         run("decode_gqa_1m", _decode_record, 32, 4, 1 << 20, 4, 16)
         run("decode_mha_1m", _decode_record, 16, 16, 1 << 20, 2, 8)
         run("decode_64k_q8", _decode_q8_record, 16, 16, 64000, 32, 128)
+        run("decode_64k_q8q", _decode_q8_record, 16, 16, 64000, 32, 128,
+            q_quant=True)
         run("train_fwd_bwd", _train_record)
         # BASELINE config 2's shape (seq 16384): MFU progress toward the
         # north star is tracked round over round at this length too.

@@ -249,6 +249,78 @@ class TestQuantizedDecode:
         with pytest.raises(ValueError):
             attention_pallas_decode_q8(q, k_q, v_q, k_s[:, :, :, :1], v_s)
 
+    def test_q8q_close_to_q8(self):
+        # The int8-MXU variant adds per-row Q quantization (~1/254 relative
+        # logit error) on top of q8's K error; outputs must stay close to
+        # the cast kernel's, and the lse (of the dequantized logits) must
+        # match within the same budget so the tree merge stays consistent.
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8,
+            attention_pallas_decode_q8q,
+            quantize_kv_channelwise,
+        )
+
+        rng = np.random.default_rng(4)
+        q, k, v = self._case(rng, Tk=700)
+        k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+        ref, ref_lse = attention_pallas_decode_q8(
+            q, k_q, v_q, k_s, v_s, causal=True, q_offset=699, block_size=256
+        )
+        out, lse = attention_pallas_decode_q8q(
+            q, k_q, v_q, k_s, v_s, causal=True, q_offset=699, block_size=256
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(ref_lse), atol=2e-2, rtol=2e-2
+        )
+
+    def test_q8q_gqa_causal_offsets_and_ragged(self):
+        from tree_attention_tpu.ops import attention_naive
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8q,
+            quantize_kv_channelwise,
+        )
+
+        rng = np.random.default_rng(5)
+        q, k, v = self._case(rng, Hq=4, Hkv=1, Tk=300)  # ragged vs bk=128
+        k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+        out, lse = attention_pallas_decode_q8q(
+            q, k_q, v_q, k_s, v_s, causal=True, q_offset=150, block_size=128
+        )
+        k_dq = jnp.asarray(k_q.astype(np.float32) * np.asarray(k_s))
+        v_dq = jnp.asarray(v_q.astype(np.float32) * np.asarray(v_s))
+        ref_out, ref_lse = attention_naive(
+            jnp.asarray(np.asarray(q, np.float32)), k_dq, v_dq,
+            causal=True, q_offset=150,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref_out),
+            atol=6e-2, rtol=6e-2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(ref_lse), atol=3e-2, rtol=3e-2
+        )
+
+    def test_q8q_empty_kv_and_validation(self):
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode_q8q,
+            quantize_kv_channelwise,
+        )
+
+        rng = np.random.default_rng(6)
+        q, k, v = self._case(rng, Tk=128)
+        k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+        out, lse = attention_pallas_decode_q8q(
+            q, k_q[:, :, :0], v_q[:, :, :0], k_s, v_s
+        )
+        assert out.shape == q.shape and float(np.abs(np.asarray(out)).max()) == 0
+        assert bool(np.all(np.isneginf(np.asarray(lse))))
+        with pytest.raises(ValueError):
+            attention_pallas_decode_q8q(q, k, v, k_s, v_s)  # not int8
+
     def test_tree_decode_q8_sharded_matches_unsharded(self):
         """Sequence-parallel q8 decode: the dequantized-lse contract makes
         the sharded merge equal the single-device q8 result."""

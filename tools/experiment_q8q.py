@@ -1,0 +1,218 @@
+"""Experiment: int8 x int8 MXU scores for the quantized-cache decode kernel.
+
+The shipped q8 decode kernel (ops/pallas_decode.py) streams int8 K/V but
+casts each tile to bf16 in-VMEM before the matmuls — at 85% of the int8
+roofline (measurements/r3), those casts are the dominant per-tile VPU cost.
+Hypothesis: quantize the (tiny, scale-folded) Q per ROW to int8 too, run
+the score matmul natively int8 x int8 -> int32 on the MXU (no K cast at
+all), and rescale the (bq, bk) int32 scores by the per-row Q scale — one
+cheap (bq, 1)-broadcast multiply. The P·V matmul keeps the bf16 V cast
+(p is a probability tile).
+
+Accuracy cost: Q rows add ~1/254 relative quantization error to the
+logits on top of q8's existing K error. This script measures BOTH the
+wall-clock and the output error vs the shipped q8 kernel; productize only
+on a clear win.
+
+Run:  python tools/experiment_q8q.py > experiment_q8q.jsonl
+"""
+
+import functools
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tree_attention_tpu.ops.block_utils import LANES, NEG_INF
+from tree_attention_tpu.ops.pallas_decode import (
+    attention_pallas_decode_q8,
+    quantize_kv_channelwise,
+)
+
+
+def log(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def _q8q_kernel(q_ref, qs_ref, k_ref, v_ref, out_ref,
+                m_scr, l_scr, acc_scr, *, tk, q_offset, block_k):
+    si = pl.program_id(1)
+    n_s = pl.num_programs(1)
+    bq = q_ref.shape[1]
+    bk = block_k
+
+    @pl.when(si == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = si * bk < tk
+
+    @pl.when(live)
+    def _():
+        # int8 x int8 -> int32 on the MXU: no K dequant cast on the stream.
+        s_i = lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        # Per-row Q scale rescales the integer scores; K's channel scale and
+        # the softmax scale were folded into Q before quantization.
+        s = s_i.astype(jnp.float32) * qs_ref[0][:, :1]
+        # Causal @ newest token + ragged tail: broadcast-form mask.
+        col = si * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        s = jnp.where(col <= q_offset, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+        p = jnp.exp(s - m_safe)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v_t = v_ref[0].astype(jnp.bfloat16)
+        if tk % bk:
+            ok = (si * bk + lax.broadcasted_iota(jnp.int32, v_t.shape, 0)) < tk
+            v_t = jnp.where(ok, v_t, 0)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p.astype(jnp.bfloat16), v_t,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == n_s - 1)
+    def _():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l <= 0.0, 1.0, l)
+        out_ref[0] = (acc_scr[...] / l_safe).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "q_offset"))
+def decode_q8q(q, k_q, v_q, k_scale, v_scale, *, q_offset, block_k=8192):
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k_q.shape[1], k_q.shape[2]
+    G = Hq // Hkv
+    r = G * Tq
+    sm = D ** -0.5
+    # Fold k_scale + softmax scale into q (f32), then per-row int8 quantize.
+    qf = q.astype(jnp.float32).reshape(B, Hkv, r, D) * (k_scale * sm)
+    amax = jnp.max(jnp.abs(qf), axis=3, keepdims=True)
+    qs = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q_i = jnp.clip(jnp.round(qf / qs), -127, 127).astype(jnp.int8)
+
+    bq = min(-(-r // 8) * 8, 128)
+    pad = bq - r % bq if r % bq else 0
+    if pad:
+        q_i = jnp.pad(q_i, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        qs = jnp.pad(qs, ((0, 0), (0, 0), (0, pad), (0, 0)),
+                     constant_values=1.0)
+    qp = q_i.reshape(B * Hkv, -1, D)
+    qsp = jnp.broadcast_to(
+        qs.reshape(B * Hkv, -1, 1), (B * Hkv, qp.shape[1], LANES)
+    )
+    kp = k_q.reshape(B * Hkv, Tk, D)
+    vp = v_q.reshape(B * Hkv, Tk, D)
+    bk = min(block_k, Tk)
+    n_s = -(-Tk // bk)
+
+    out = pl.pallas_call(
+        functools.partial(_q8q_kernel, tk=Tk, q_offset=q_offset, block_k=bk),
+        grid=(B * Hkv, n_s),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, si: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, si: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, si: (bh, si, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, si: (bh, si, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, si: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, bq, D), jnp.bfloat16),
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qp, qsp, kp, vp)
+    out = out[:, :r].reshape(B, Hq, Tq, D)
+    # V channel scale in the epilogue, like the shipped wrapper.
+    out = (
+        out.astype(jnp.float32).reshape(B, Hkv, r, D) * v_scale
+    ).reshape(B, Hq, Tq, D)
+    return out
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", "experiment needs the chip"
+    log({"stage": "start", "device": str(jax.devices()[0])})
+
+    from tree_attention_tpu.utils.profiling import time_per_step
+
+    H, Hkv, T, D = 16, 16, 64000, 128
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (1, H, 1, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, Hkv, T, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, Hkv, T, D), jnp.bfloat16)
+    k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+
+    # --- correctness vs the shipped q8 kernel ---
+    ref, _ = attention_pallas_decode_q8(
+        q, k_q, v_q, k_s, v_s, causal=True, q_offset=T - 1
+    )
+    got = decode_q8q(q, k_q, v_q, k_s, v_s, q_offset=T - 1)
+    err = float(jnp.max(jnp.abs(
+        got.astype(jnp.float32) - ref.astype(jnp.float32)
+    )))
+    rel = err / float(jnp.max(jnp.abs(ref.astype(jnp.float32))))
+    log({"stage": "accuracy", "max_abs_err_vs_q8": round(err, 5),
+         "rel": round(rel, 5)})
+
+    # --- wall clock, both kernels, same slope protocol ---
+    def chain_of(fn):
+        def mk(n):
+            def f(qc, kq_, vq_):
+                def body(c, _):
+                    return fn(c, kq_, vq_).astype(c.dtype), None
+
+                out = lax.scan(body, qc, None, length=n)[0]
+                return jnp.sum(out.astype(jnp.float32))
+
+            return jax.jit(f)
+
+        return mk
+
+    for name, fn, bk in (
+        ("q8_shipped", lambda c, a, b: attention_pallas_decode_q8(
+            c, a, b, k_s, v_s, causal=True, q_offset=T - 1)[0], None),
+        ("q8q_int8mxu_bk8192", lambda c, a, b: decode_q8q(
+            c, a, b, k_s, v_s, q_offset=T - 1, block_k=8192), 8192),
+        ("q8q_int8mxu_bk16384", lambda c, a, b: decode_q8q(
+            c, a, b, k_s, v_s, q_offset=T - 1, block_k=16384), 16384),
+    ):
+        try:
+            per, _, _ = time_per_step(
+                chain_of(fn), q, k_q, v_q, n_small=64, n_large=256,
+                iters=5, warmup=1, stat="min",
+            )
+            bw = 2 * T * Hkv * D / per
+            log({"kernel": name, "us": round(per * 1e6, 1),
+                 "pct_int8_roofline": round(bw / 819e9 * 100, 1)})
+        except Exception as e:
+            log({"kernel": name, "error": f"{type(e).__name__}: {e}"[:300]})
+
+    log({"stage": "done"})
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,87 @@ from tree_attention_tpu.ops.block_utils import (
 )
 
 
+def _decode_visibility_mask(s, qi, si, *, bq, bk, tq, tk,
+                            q_offset, kv_offset, causal):
+    """Ragged-tail + causal masking for one (bq, bk) decode score tile —
+    the ONE mask definition shared by the bf16-cast and int8-MXU kernels.
+
+    Lane i is KV global position kv_offset + si*bk + i; sublane j is query
+    row ((qi*bq + j) % Tq) at global position q_offset + that. Padded rows
+    (j >= r) alias a real query's position and compute a duplicate row the
+    host slices away. Broadcast form: (bq, 1) row positions vs (1, bk)
+    column positions — one broadcast compare, no full-tile iota
+    materialisation (see block_utils.mask_scores for why not a lax.cond
+    interior skip). Static no-op for non-causal divisible shapes.
+    """
+    needs_ragged = tk % bk != 0
+    if not (causal or needs_ragged):
+        return s
+    col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    valid = None
+    if needs_ragged:
+        valid = col_idx < tk
+    if causal:
+        q_pos = q_offset + (
+            (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)) % tq
+        )
+        c = (kv_offset + col_idx) <= q_pos
+        valid = c if valid is None else valid & c
+    return jnp.where(valid, s, NEG_INF)
+
+
+def _decode_softmax_fold(s, v_tile, m_scr, l_scr, acc_scr, *, si, bk, tk):
+    """Fold one masked score tile and its V tile into the running
+    online-softmax state — shared by both decode kernels.
+
+    P·V with the FA2 p-downcast (probabilities are in [0,1], bf16 relative
+    error stays small), f32 accumulation. When Tk is ragged the last tile's
+    trailing V rows are unspecified garbage (Pallas loads the partial block
+    unpadded; interpret mode NaN-poisons it) — p's masked columns are
+    exactly 0, but 0·NaN = NaN, so those rows must be zeroed. Static no-op
+    for divisible shapes.
+    """
+    m_prev = m_scr[:, :1]  # (bq, 1)
+    l_prev = l_scr[:, :1]
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+    p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if v_tile.dtype == jnp.int8:
+        v_tile = v_tile.astype(jnp.bfloat16)
+    if tk % bk:
+        row_ok = (
+            si * bk + lax.broadcasted_iota(jnp.int32, v_tile.shape, 0)
+        ) < tk
+        v_tile = jnp.where(row_ok, v_tile, 0)
+    acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+        p.astype(v_tile.dtype), v_tile,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(v_tile.dtype, v_tile.dtype),
+    )
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
+def _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr):
+    """Emit (out, lse) from the final online-softmax state — shared by both
+    decode kernels. Rows with no visible keys emit 0 / -inf."""
+    m = m_scr[:, :1]
+    l = l_scr[:, :1]
+    empty = l <= 0.0
+    l_safe = jnp.where(empty, 1.0, l)
+    out_ref[0] = (
+        jnp.where(empty, 0.0, acc_scr[...] / l_safe)
+    ).astype(out_ref.dtype)
+    lse = jnp.where(
+        empty, NEG_INF, jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)
+    )
+    lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
 def _flash_decode_kernel(
     offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
     q_ref,     # VMEM (1, bq, D) — packed (group × Tq) queries of one KV head
@@ -109,73 +190,85 @@ def _flash_decode_kernel(
             precision=matmul_precision(q_ref.dtype, k_tile.dtype),
         ) * scale  # (bq, bk) f32
 
-        # Visibility: lane i is KV global position kv_offset + si*bk + i;
-        # sublane j is query row ((qi*bq + j) % Tq) at global position
-        # q_offset + that. Padded rows (j >= r) alias a real query's position
-        # and compute a duplicate row the host slices away. Broadcast-form
-        # mask: (bq, 1) row positions vs (1, bk) column positions — one
-        # broadcast compare, no full-tile iota materialisation (see
-        # block_utils.mask_scores for why not a lax.cond interior skip).
-        needs_ragged = tk % bk != 0
-        if causal or needs_ragged:
-            col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-            valid = None
-            if needs_ragged:
-                valid = col_idx < tk
-            if causal:
-                q_pos = q_offset + (
-                    (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
-                    % tq
-                )
-                c = (kv_offset + col_idx) <= q_pos
-                valid = c if valid is None else valid & c
-            s = jnp.where(valid, s, NEG_INF)
-
-        m_prev = m_scr[:, :1]  # (bq, 1)
-        l_prev = l_scr[:, :1]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
-        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
-        p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-
-        # P·V with the FA2 p-downcast (probabilities are in [0,1], bf16
-        # relative error stays small), f32 accumulation. When Tk is ragged
-        # the last tile's trailing V rows are unspecified garbage (Pallas
-        # loads the partial block unpadded; interpret mode NaN-poisons it) —
-        # p's masked columns are exactly 0, but 0·NaN = NaN, so those rows
-        # must be zeroed. Static no-op for divisible shapes.
-        v_tile = v_ref[0]
-        if v_tile.dtype == jnp.int8:
-            v_tile = v_tile.astype(jnp.bfloat16)
-        if tk % bk:
-            row_ok = (
-                si * bk + lax.broadcasted_iota(jnp.int32, v_tile.shape, 0)
-            ) < tk
-            v_tile = jnp.where(row_ok, v_tile, 0)
-        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
-            p.astype(v_tile.dtype), v_tile,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=matmul_precision(v_tile.dtype, v_tile.dtype),
+        s = _decode_visibility_mask(
+            s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
+            q_offset=q_offset, kv_offset=kv_offset, causal=causal,
         )
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        _decode_softmax_fold(
+            s, v_ref[0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
+        )
 
     @pl.when(si == n_s - 1)
     def _finalize():
-        m = m_scr[:, :1]
-        l = l_scr[:, :1]
-        empty = l <= 0.0
-        l_safe = jnp.where(empty, 1.0, l)
-        out_ref[0] = (
-            jnp.where(empty, 0.0, acc_scr[...] / l_safe)
-        ).astype(out_ref.dtype)
-        lse = jnp.where(
-            empty, NEG_INF, jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)
+        _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _flash_decode_q8q_kernel(
+    offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
+    q_ref,     # VMEM (1, bq, D) int8 — per-row-quantized, scale-folded Q
+    qs_ref,    # VMEM (1, bq, LANES) f32 — per-row Q scales (lane-broadcast)
+    k_ref,     # VMEM (1, bk, D) int8
+    v_ref,     # VMEM (1, bk, D) int8
+    out_ref,   # VMEM (1, bq, D)
+    lse_ref,   # VMEM (1, bq, LANES)
+    m_scr,     # VMEM (bq, LANES) f32
+    l_scr,     # VMEM (bq, LANES) f32
+    acc_scr,   # VMEM (bq, D) f32
+    *,
+    causal: bool,
+    tk: int,
+    tq: int,
+    block_q: int,
+    block_k: int,
+):
+    """The int8-MXU variant of :func:`_flash_decode_kernel`: scores run
+    natively int8 x int8 -> int32 (no K dequant cast on the KV stream — the
+    bf16-cast kernel's dominant per-tile VPU cost) and are rescaled by the
+    per-row Q scale, one (bq, 1)-broadcast multiply. Measured 92.0% of the
+    int8 roofline at 64k ctx vs 85.7% for the cast kernel
+    (measurements/r3/experiment_q8q.jsonl). Same online-softmax state and
+    ``(out, lse)`` contract; the lse is of the dequantized logits, so the
+    output plugs into the tree merge unchanged."""
+    qi = pl.program_id(1)
+    si = pl.program_id(2)
+    n_s = pl.num_programs(2)
+
+    q_offset = offs_ref[0, 0]
+    kv_offset = offs_ref[1, 0]
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    bq, bk = block_q, block_k
+
+    live = si * bk < tk
+    if causal:
+        live &= (kv_offset + si * bk) <= (q_offset + tq - 1)
+
+    @pl.when(live)
+    def _compute():
+        s_i = lax.dot_general(
+            q_ref[0],
+            k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
         )
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        s = s_i.astype(jnp.float32) * qs_ref[0][:, :1]  # (bq, bk) f32
+
+        s = _decode_visibility_mask(
+            s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
+            q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+        )
+        _decode_softmax_fold(
+            s, v_ref[0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
+        )
+
+    @pl.when(si == n_s - 1)
+    def _finalize():
+        _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
 def quantize_kv_channelwise(
@@ -277,6 +370,137 @@ def attention_pallas_decode_q8(
     out = (
         out.astype(jnp.float32).reshape(B, Hkv, G * Tq, D) * v_scale
     ).reshape(B, Hq, Tq, D).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_size", "interpret"),
+)
+def attention_pallas_decode_q8q(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_size: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """int8-MXU flash decode over an int8 KV buffer: Q quantized too.
+
+    Same contract and cache format as :func:`attention_pallas_decode_q8`,
+    one step further down the precision/bandwidth trade: K's channel scale
+    and the softmax scale fold into Q in f32, then each packed query ROW is
+    absmax-quantized to int8, the score matmul runs natively
+    int8 x int8 -> int32 on the MXU (no per-tile K dequant cast — the cast
+    kernel's dominant VPU cost), and the int32 scores are rescaled by the
+    per-row Q scale. Measured 92% of the int8 roofline at 64k ctx vs 86%
+    for the cast kernel; adds ~1/254 relative Q-rounding error to the
+    logits on top of q8's K error (measured max 0.7% relative output
+    error; see measurements/r3/experiment_q8q.jsonl).
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k_q.shape[1], k_q.shape[2]
+    if k_q.dtype != jnp.int8 or v_q.dtype != jnp.int8:
+        raise ValueError(
+            f"k_q/v_q must be int8, got {k_q.dtype}/{v_q.dtype}"
+        )
+    if k_scale.shape != (B, Hkv, 1, D) or v_scale.shape != (B, Hkv, 1, D):
+        raise ValueError(
+            f"scales must be (B, Hkv, 1, D) = {(B, Hkv, 1, D)}, got "
+            f"{k_scale.shape}/{v_scale.shape}"
+        )
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    sm = (D ** -0.5) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out_dtype = q.dtype
+
+    if Tk == 0:
+        return (
+            jnp.zeros(q.shape, out_dtype),
+            jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),
+        )
+
+    # Fold both scales into Q in f32, then per-row absmax int8 quantize
+    # (the one q8 numeric contract, quantize_symmetric_int8, reduced over
+    # the head-dim axis) — the row scale rides a separate (bq, LANES)
+    # input into the kernel.
+    r = G * Tq
+    qf = q.astype(jnp.float32).reshape(B, Hkv, r, D) * (k_scale * sm)
+    q_i, qs = quantize_symmetric_int8(qf, axis=3)
+
+    bq = min(-(-r // 8) * 8, 128)
+    qp = _pad_dim(q_i, 2, bq).reshape(B * Hkv, -1, D)
+    n_q = qp.shape[1] // bq
+    # Padded rows get scale 0 — their int32 scores then rescale to exactly
+    # 0 everywhere, a harmless finite value (the host slices those rows
+    # away; under causality they alias a real row's mask anyway).
+    qsp = jnp.broadcast_to(
+        _pad_dim(qs, 2, bq).reshape(B * Hkv, n_q * bq, 1),
+        (B * Hkv, n_q * bq, _LANES),
+    )
+
+    if block_size is None:
+        from tree_attention_tpu.ops.tuning import decode_block_k_q8
+
+        block_size = decode_block_k_q8(Tk)
+    bk = min(block_size, max(Tk, _LANES))
+    kp = k_q.reshape(B * Hkv, Tk, D)
+    vp = v_q.reshape(B * Hkv, Tk, D)
+    n_s = -(-Tk // bk)
+
+    offs = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    ).reshape(2, 1)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash_decode_q8q_kernel,
+            causal=causal, tk=Tk, tq=Tq, block_q=bq, block_k=bk,
+        ),
+        grid=(B * Hkv, n_q, n_s),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * Hkv, n_q * bq, D), jnp.bfloat16),
+            jax.ShapeDtypeStruct((B * Hkv, n_q * bq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offs, qp, qsp, kp, vp)
+
+    out = out[:, :r]
+    # V's per-channel scale on the normalised accumulator, like the q8 path.
+    out = (
+        out.astype(jnp.float32).reshape(B, Hkv, r, D) * v_scale
+    ).reshape(B, Hq, Tq, D).astype(out_dtype)
+    lse = lse[:, :r, 0].reshape(B, Hq, Tq)
     return out, lse
 
 
