@@ -196,7 +196,7 @@ def _live_tiles(Tq, Tk, bq, bk, q_off=0, kv_off=0, causal=True):
     return int(((q_off + qi * bq + bq - 1) >= (kv_off + ki * bk)).sum())
 
 
-def _train_record(T=4096, n_small=8, n_large=32):
+def _train_record(T=4096, n_small=16, n_large=64):
     """Causal training-shape fwd and fwd+bwd through the Pallas kernels.
 
     FLOPs are counted from the kernel launches (VERDICT r2 weak item 3):
