@@ -7,12 +7,16 @@ run (what makes the Pallas revisiting pipeline skip the copy).
 Both are checked here exhaustively over small geometries.
 """
 
+import numpy as np
 import pytest
+import jax.numpy as jnp
 
 from tree_attention_tpu.ops.block_utils import (
+    NEG_INF,
     causal_first_live_q,
     causal_last_live_k,
     culled_ki,
+    mask_scores,
     culled_qi,
     tile_live,
 )
@@ -98,3 +102,39 @@ def test_boundaries_match_tile_live(geom):
             assert live == [qi >= lo for qi in range(n_q)], (geom, ki, lo)
         else:
             assert lo == n_q - 1, (geom, ki, lo)
+
+
+class TestMaskScores:
+    """mask_scores: the one mask definition shared by the fwd and both bwd
+    kernels — semantics pinned against a dense index-arithmetic oracle."""
+
+    def _oracle(self, bq, bk, qi, ki, qo, ko, tk, causal):
+        rows = qo + qi * bq + np.arange(bq)[:, None]
+        cols_local = ki * bk + np.arange(bk)[None, :]
+        valid = cols_local < tk
+        if causal:
+            valid = valid & (rows >= (ko + cols_local))
+        return np.broadcast_to(valid, (bq, bk))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("qi,ki,qo,ko,tk", [
+        (0, 0, 0, 0, 12),      # ragged tail inside the FIRST tile (12 < bk)
+        (1, 2, 0, 0, 64),      # diagonal-straddling tile, divisible tk
+        (3, 0, 16, 0, 64),     # offset Q (sharded geometry)
+        (0, 3, 0, 32, 50),     # offset KV + ragged
+    ])
+    def test_matches_dense_oracle(self, causal, qi, ki, qo, ko, tk):
+        bq, bk = 8, 16
+        rng = np.random.default_rng(0)
+        s = jnp.asarray(rng.standard_normal((bq, bk)), jnp.float32)
+        got = np.asarray(mask_scores(s, qi, ki, bq, bk, qo, ko, tk, causal))
+        valid = self._oracle(bq, bk, qi, ki, qo, ko, tk, causal)
+        np.testing.assert_array_equal(got == NEG_INF, ~valid)
+        np.testing.assert_allclose(got[valid], np.asarray(s)[valid])
+
+    def test_static_noop_for_non_causal_divisible(self):
+        # The masked where must vanish entirely (same object returned) when
+        # nothing can be masked — the kernels rely on this static shortcut.
+        s = jnp.ones((8, 16), jnp.float32)
+        out = mask_scores(s, 2, 3, 8, 16, 0, 0, 64, causal=False)
+        assert out is s
