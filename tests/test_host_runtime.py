@@ -434,3 +434,19 @@ class TestElasticLaunch:
         monkeypatch.setenv("TA_FAULT_RANK", "0")
         monkeypatch.setenv("JAX_PROCESS_INDEX", "1")
         hr.maybe_inject_fault(3)  # wrong rank: no-op
+
+    def test_fault_injection_malformed_env_disarms(self, monkeypatch, caplog):
+        # A typo'd spec must disarm with one warning, not ValueError on the
+        # per-step path (ADVICE r3); repeated steps must not re-warn.
+        monkeypatch.setenv("TA_FAULT_STEP", "not-a-step")
+        monkeypatch.delenv("TA_FAULT_RANK", raising=False)
+        with caplog.at_level("WARNING", logger=hr.log.name):
+            hr.maybe_inject_fault(0)
+            hr.maybe_inject_fault(1)
+        warnings = [r for r in caplog.records if "disarmed" in r.getMessage()]
+        assert len(warnings) == 1
+        # Correcting the env re-arms without a process restart.
+        monkeypatch.setenv("TA_FAULT_STEP", "7")
+        monkeypatch.setenv("TA_FAULT_RANK", "5")  # not our rank: no exit
+        monkeypatch.setenv("JAX_PROCESS_INDEX", "0")
+        hr.maybe_inject_fault(7)

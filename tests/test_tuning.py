@@ -37,7 +37,11 @@ def test_train_tiles_bucketed_by_seq_len():
     bq16k = default_block_q(16_384, 16_384)
     assert (bq4k, bk4k) == (512, 2048)
     assert bq16k >= bq4k  # deeper Q tile measured faster at long seq
-    assert default_block_size("blockwise", 4096) == bk4k
+    # blockwise keeps its own (unmeasured-by-the-campaign) default; the
+    # Pallas-measured table must not leak into the XLA fallback (ADVICE r3).
+    from tree_attention_tpu.ops.tuning import BLOCKWISE_BLOCK_K
+
+    assert default_block_size("blockwise", 4096) == BLOCKWISE_BLOCK_K == 512
 
 
 def test_bwd_default_block_q_vmem_capped():

@@ -468,12 +468,41 @@ def last_launch_attempts() -> int:
 _LAST_LAUNCH = {"attempts": 1}
 
 
+_FAULT_SPEC_CACHE: dict = {}
+
+
+def _fault_spec():
+    """(step, rank) to die at, or None. Parsed from the environment once per
+    process — this runs on the production per-step path, and a typo'd
+    TA_FAULT_STEP must surface as one clear warning, not a ValueError
+    traceback mid-train on every step (ADVICE r3)."""
+    raw_step = os.environ.get("TA_FAULT_STEP")
+    raw_rank = os.environ.get("TA_FAULT_RANK", "0")
+    key = (raw_step, raw_rank)
+    if key not in _FAULT_SPEC_CACHE:
+        spec = None
+        if raw_step is not None:
+            try:
+                spec = (int(raw_step), int(raw_rank))
+            except ValueError:
+                log.warning(
+                    "fault injection disarmed: unparsable TA_FAULT_STEP=%r / "
+                    "TA_FAULT_RANK=%r (expected integers)",
+                    raw_step,
+                    raw_rank,
+                )
+        _FAULT_SPEC_CACHE.clear()  # at most one armed spec per process
+        _FAULT_SPEC_CACHE[key] = spec
+    return _FAULT_SPEC_CACHE[key]
+
+
 def maybe_inject_fault(step: int) -> None:
     """Fault injection for exercising the supervision/recovery machinery
     (SURVEY §5: the reference has no failure handling at all — a crashed
     rank hangs its peers' allreduce forever).
 
-    Armed by environment, so production runs pay one getenv per step:
+    Armed by environment, so production runs pay two getenvs and a dict
+    lookup per step:
 
     - ``TA_FAULT_STEP`` (int): the step index at which to die; unset = off.
     - ``TA_FAULT_RANK`` (int, default 0): which rank dies.
@@ -486,11 +515,15 @@ def maybe_inject_fault(step: int) -> None:
     shape of a real crash. 86 is distinct from the supervisor's other
     statuses (124 deadline, 125 stall, 128+sig).
     """
-    spec = os.environ.get("TA_FAULT_STEP")
-    if spec is None or step != int(spec):
+    spec = _fault_spec()
+    if spec is None or step != spec[0]:
         return
-    rank = int(os.environ.get("TA_FAULT_RANK", "0"))
-    if int(os.environ.get("JAX_PROCESS_INDEX", "0")) != rank:
+    rank = spec[1]
+    try:
+        my_rank = int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+    except ValueError:
+        return  # non-numeric launcher rank: never crash the step loop
+    if my_rank != rank:
         return
     once = os.environ.get("TA_FAULT_ONCE_FILE")
     if once:

@@ -89,8 +89,19 @@ def _train_tile(t: int):
     raise AssertionError("unreachable")
 
 
+# KV block for the XLA blockwise fallback. The _TRAIN_TILES table above was
+# measured on the Pallas kernels only; blockwise (a lax.scan over KV chunks,
+# any backend) keeps the round-1 default so an unmeasured table change can't
+# silently shift its memory/perf profile on CPU/GPU (ADVICE r3).
+BLOCKWISE_BLOCK_K = 512
+
+
 def default_block_size(impl: str, tk: int) -> int:
-    return decode_block_k(tk) if impl == "pallas_decode" else _train_tile(tk)[1]
+    if impl == "pallas_decode":
+        return decode_block_k(tk)
+    if impl == "pallas":
+        return _train_tile(tk)[1]
+    return BLOCKWISE_BLOCK_K
 
 
 # VMEM ceiling for the backward kernels' Q tile. The bwd kernels hold more
