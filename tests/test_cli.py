@@ -241,13 +241,25 @@ class TestCLI:
         assert len(record["losses"]) == 2 and all(l > 0 for l in record["losses"])
 
     def test_decode_kv_quant_int8(self):
+        # 'int8' now runs the int8-MXU q8q kernel (VERDICT r3 item 2).
         record, _ = run_cli(
             "--device", "cpu", "--seq-len", "384", "--heads", "4",
             "--head-dim", "32", "--dtype", "bfloat16", "--kv-quant", "int8",
             "--iters", "2", "--warmup", "1", timeout=300,
         )
-        assert record["name"] == "decode_q8"
+        assert record["name"] == "decode_q8q"
         assert record["workload"]["kv_quant"] == "int8"
+        assert record["tokens_per_sec"] > 0
+
+    def test_decode_kv_quant_int8_cast(self):
+        record, _ = run_cli(
+            "--device", "cpu", "--seq-len", "384", "--heads", "4",
+            "--head-dim", "32", "--dtype", "bfloat16",
+            "--kv-quant", "int8-cast",
+            "--iters", "2", "--warmup", "1", timeout=300,
+        )
+        assert record["name"] == "decode_q8"
+        assert record["workload"]["kv_quant"] == "int8-cast"
         assert record["tokens_per_sec"] > 0
 
     def test_decode_kv_quant_int8_sharded(self):
@@ -257,7 +269,7 @@ class TestCLI:
             "--n-virtual-cpu", "4", "--mesh", "seq=4", "--block-size", "64",
             "--iters", "2", "--warmup", "1", timeout=300,
         )
-        assert record["name"] == "tree_decode_q8"
+        assert record["name"] == "tree_decode_q8q"
         assert record["n_devices"] == 4
 
     def test_generate_kv_quant_int8(self):

@@ -262,8 +262,10 @@ def test_generate_quantize_after_prefill_runs_and_matches_greedy_mostly():
     assert np.all((np.asarray(toks_q) >= 0) & (np.asarray(toks_q) < CFG.vocab_size))
 
 
-def test_quantized_decode_sharded_matches_unsharded():
-    """QuantKVCache over a 4-way seq mesh: tree_decode_q8 merge == one device."""
+@pytest.mark.parametrize("quant_kernel", ["q8q", "q8"])
+def test_quantized_decode_sharded_matches_unsharded(quant_kernel):
+    """QuantKVCache over a 4-way seq mesh: the tree merge == one device,
+    for both the int8-MXU (q8q, the default) and bf16-cast (q8) kernels."""
     from tree_attention_tpu.models import quantize_cache
 
     params = init_params(jax.random.PRNGKey(0), CFG)
@@ -277,7 +279,10 @@ def test_quantized_decode_sharded_matches_unsharded():
         cache = quantize_cache(cache)
         outs = []
         for t in range(16, 24):
-            logits, cache = forward_step(params, tokens[:, t:t + 1], cache, CFG, **kw)
+            logits, cache = forward_step(
+                params, tokens[:, t:t + 1], cache, CFG,
+                quant_kernel=quant_kernel, **kw,
+            )
             outs.append(np.asarray(logits))
         return np.concatenate(outs, axis=1)
 
@@ -286,8 +291,11 @@ def test_quantized_decode_sharded_matches_unsharded():
     )
 
 
-def test_q8_long_horizon_drift_bounded():
-    """VERDICT r2 item 7: quantize-after-prefill drift over a long decode.
+@pytest.mark.parametrize("quant_kernel", ["q8q", "q8"])
+def test_q8_long_horizon_drift_bounded(quant_kernel):
+    """VERDICT r2 item 7 / r3 item 2: quantize-after-prefill drift over a
+    long decode, for both int8 kernels — q8q's extra per-row Q-rounding
+    error is exactly the kind that could compound over a horizon.
 
     Teacher-forced comparison isolates cache-quantization drift from
     trajectory divergence: both caches see the *same* token stream (the
@@ -314,7 +322,9 @@ def test_q8_long_horizon_drift_bounded():
     max_err, agree = 0.0, 0
     for _ in range(n_steps):
         logits_e, exact = forward_step(params, tok, exact, CFG)
-        logits_q, quant = forward_step(params, tok, quant, CFG)
+        logits_q, quant = forward_step(
+            params, tok, quant, CFG, quant_kernel=quant_kernel
+        )
         le = np.asarray(logits_e[:, -1], np.float32)
         lq = np.asarray(logits_q[:, -1], np.float32)
         max_err = max(max_err, float(np.abs(le - lq).max()))

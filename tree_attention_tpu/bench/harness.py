@@ -132,10 +132,13 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
         q_len=cfg.q_len, seq_len=cfg.seq_len, head_dim=cfg.head_dim,
         dtype=dtype,
     )
-    quant = cfg.kv_quant == "int8"
+    # 'int8' is the int8-MXU q8q kernel (the fastest decode path);
+    # 'int8-cast' keeps the bf16-cast kernel. Validates kv_quant too.
+    quant_kernel = cfg.resolved_quant_kernel()
+    quant = quant_kernel is not None
     if quant and cfg.impl not in ("auto", "pallas_decode"):
         raise ValueError(
-            f"--kv-quant int8 runs the pallas_decode q8 kernel; "
+            f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
             f"--impl {cfg.impl} cannot serve a quantized buffer"
         )
 
@@ -153,27 +156,28 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
     extra = {}
     if quant:
         from tree_attention_tpu.ops.pallas_decode import (
-            attention_pallas_decode_q8,
             quantize_kv_channelwise,
+            resolve_q8_kernel,
         )
 
         # Per-channel scales are shard-invariant, so global quantization
         # shards as-is (jnp ops run distributed on sharded inputs).
         k, v, k_s, v_s = quantize_kv_channelwise(k, v)
-        extra = {"kv_quant": "int8"}
+        extra = {"kv_quant": cfg.kv_quant}
         if mesh is None:
-            name = "decode_q8"
+            name = "decode_" + quant_kernel
+            kernel_fn = resolve_q8_kernel(quant_kernel)
             # block_size=None resolves inside the wrapper via the q8 tile
             # table — the bench times the production default path.
-            fn = jax.jit(lambda q, k, v: attention_pallas_decode_q8(
+            fn = jax.jit(lambda q, k, v: kernel_fn(
                 q, k, v, k_s, v_s, causal=cfg.causal,
                 block_size=cfg.block_size,
             )[0])
         else:
-            name = "tree_decode_q8"
+            name = "tree_decode_" + quant_kernel
             fn = jax.jit(lambda q, k, v: tree_decode_q8(
                 q, k, v, k_s, v_s, mesh=mesh, causal=cfg.causal,
-                block_size=cfg.block_size,
+                block_size=cfg.block_size, kernel=quant_kernel,
                 data_axis=axes["data"], head_axis=axes["model"],
             )[0])
     elif mesh is None:

@@ -396,10 +396,10 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
         raise SystemExit("--temperature must be >= 0 (0 = greedy)")
     if cfg.max_new_tokens < 1:
         raise SystemExit("--max-new-tokens must be >= 1")
-    if cfg.kv_quant == "int8" and cfg.impl not in ("auto", "pallas_decode"):
+    if cfg.kv_quant != "none" and cfg.impl not in ("auto", "pallas_decode"):
         # Same rejection the bench surface gives this flag pair.
         raise SystemExit(
-            f"--kv-quant int8 runs the pallas_decode q8 kernel; "
+            f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
             f"--impl {cfg.impl} cannot serve a quantized buffer"
         )
     tcfg = _transformer_config(cfg)
@@ -418,19 +418,20 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
         params, prompt, n_new, tcfg,
         temperature=cfg.temperature, key=jax.random.PRNGKey(cfg.seed + 2),
         mesh=mesh,
-        quantize_after_prefill=cfg.kv_quant == "int8",
+        quantize_after_prefill=cfg.kv_quant != "none",
+        quant_kernel=cfg.resolved_quant_kernel() or "q8q",
     )
     toks = jax.block_until_ready(toks)
     heartbeat()
     log.info(
         "generated %s tokens from a %s prompt%s",
         toks.shape, prompt.shape,
-        " (int8 KV cache)" if cfg.kv_quant == "int8" else "",
+        f" ({cfg.kv_quant} KV cache)" if cfg.kv_quant != "none" else "",
     )
     _emit({
         "mode": "generate",
         "tokens": toks.tolist(),
-        **({"kv_quant": "int8"} if cfg.kv_quant == "int8" else {}),
+        **({"kv_quant": cfg.kv_quant} if cfg.kv_quant != "none" else {}),
     })
     return 0
 

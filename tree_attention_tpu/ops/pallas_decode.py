@@ -271,6 +271,17 @@ def _flash_decode_q8q_kernel(
         _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
+def resolve_q8_kernel(kernel: str):
+    """The one home of the q8-kernel-name contract: ``"q8q"`` → the int8-MXU
+    kernel (:func:`attention_pallas_decode_q8q`), ``"q8"`` → the bf16-cast
+    kernel (:func:`attention_pallas_decode_q8`); anything else raises."""
+    if kernel == "q8q":
+        return attention_pallas_decode_q8q
+    if kernel == "q8":
+        return attention_pallas_decode_q8
+    raise ValueError(f"q8 kernel must be 'q8q' or 'q8', got {kernel!r}")
+
+
 def quantize_kv_channelwise(
     k: jax.Array, v: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:

@@ -58,7 +58,7 @@ class RunConfig:
     restarts: int = 0  # elastic: whole-gang relaunches after a failure
     impl: str = "auto"  # auto | naive | blockwise | pallas | pallas_decode
     block_size: Optional[int] = None  # None -> impl-appropriate default
-    kv_quant: str = "none"  # none | int8 (decode/generate: quantized KV)
+    kv_quant: str = "none"  # none | int8 (int8-MXU q8q) | int8-cast (bf16-cast q8)
     seq_layout: str = "contiguous"  # contiguous | zigzag (train mode, seq>1)
     seed: int = 0
 
@@ -98,6 +98,19 @@ class RunConfig:
 
     def resolved_kv_heads(self) -> int:
         return self.heads if self.kv_heads is None else self.kv_heads
+
+    def resolved_quant_kernel(self) -> Optional[str]:
+        """kv_quant → q8 kernel name (the one home of that mapping):
+        'int8' → 'q8q' (int8-MXU, fastest), 'int8-cast' → 'q8' (bf16-cast),
+        'none' → None. Programmatic configs bypass argparse's choices, so
+        an unknown value raises here rather than silently running int8."""
+        kernels = {"none": None, "int8": "q8q", "int8-cast": "q8"}
+        if self.kv_quant not in kernels:
+            raise ValueError(
+                f"kv_quant must be one of {sorted(kernels)}, "
+                f"got {self.kv_quant!r}"
+            )
+        return kernels[self.kv_quant]
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -158,10 +171,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    default=d.impl)
     p.add_argument("--block-size", type=int, default=d.block_size,
                    help="KV tile length (default: per-impl tuned value)")
-    p.add_argument("--kv-quant", choices=["none", "int8"], default=d.kv_quant,
+    p.add_argument("--kv-quant", choices=["none", "int8", "int8-cast"],
+                   default=d.kv_quant,
                    help="decode: int8-quantize the KV buffer; generate: "
                         "quantize the cache after prefill (per-channel "
-                        "scales; halves the KV stream)")
+                        "scales; halves the KV stream). 'int8' runs the "
+                        "int8-MXU q8q kernel (fastest); 'int8-cast' the "
+                        "bf16-cast q8 kernel (minimum int8 error)")
     p.add_argument("--seq-layout", choices=["contiguous", "zigzag"],
                    default=d.seq_layout,
                    help="train mode: sequence layout over the seq mesh axis "
