@@ -14,6 +14,13 @@ Workloads (VERDICT round-1 item 5 — one driver-parseable record):
   through the Pallas kernels at seq 4096: TFLOP/s and MFU vs the v5e bf16
   peak, with FLOPs counted from the kernels' live-tile launches.
 - ``train_fwd_bwd_16k`` — the same at seq 16384 (BASELINE config 2's shape).
+- ``tree_vs_ring_decode_cpu8`` — tree vs ring vs Ulysses on the DECODE
+  shape (q_len=1, the reference's 16h×128D workload) over the emulated
+  8-way mesh, at two contexts (64000 and 2048), each algorithm with
+  collective counts and payload bytes parsed from its compiled SPMD
+  module (``bench/comm.py``). The accounting — not the emulated wall
+  clock — is the number that transfers to real ICI: ``tools/ici_model.py``
+  prices it (BASELINE.md north-star section).
 - ``tree_vs_ring``    — tree- vs ring- (and zigzag-tree / Ulysses-)
   attention step time on an emulated 8-way sequence mesh (clean
   subprocess, CPU backend; the BASELINE.json north-star ratio's shape).
@@ -285,10 +292,11 @@ def _train_record(T=4096, n_small=16, n_large=64):
     }
 
 
-def _tree_vs_ring_record():
-    """Tree vs ring on an emulated 8-way seq mesh, in a clean CPU subprocess
-    (this process owns the TPU client; the emulated mesh needs a CPU-only
-    process with the host-device-count flag set before JAX init)."""
+def _comparator_subprocess(args, timeout=900):
+    """Run a CLI comparator bench on an emulated 8-way seq mesh, in a clean
+    CPU subprocess (this process owns the TPU client; the emulated mesh
+    needs a CPU-only process with the host-device-count flag set before
+    JAX init). Returns the CLI's JSON record."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     flags = env.get("XLA_FLAGS", "")
@@ -296,18 +304,13 @@ def _tree_vs_ring_record():
         env["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count=8".strip()
         )
-    # heads=8 (divisible by the 8-way mesh) lets the Ulysses family join
-    # the same record; per-head FLOPs halve via head_dim to keep the
-    # record's runtime in its old envelope.
     proc = subprocess.run(
         [sys.executable, "-m", "tree_attention_tpu", "--mode", "bench",
-         "--comparator", "ring", "--device", "cpu", "--n-virtual-cpu", "8",
-         "--mesh", "seq=8", "--seq-len", "4096", "--causal",
-         "--heads", "8", "--head-dim", "32", "--iters", "3",
-         "--dtype", "float32"],
+         "--device", "cpu", "--n-virtual-cpu", "8", "--mesh", "seq=8",
+         "--causal"] + args,
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        timeout=900,
+        timeout=timeout,
     )
     if proc.returncode != 0:
         raise RuntimeError(
@@ -319,6 +322,51 @@ def _tree_vs_ring_record():
         if line.startswith("{"):
             return json.loads(line)
     raise RuntimeError("comparator subprocess printed no JSON")
+
+
+def _tree_vs_ring_record():
+    """Tree vs ring on the TRAINING shape (fwd+bwd, all-sharded Q/K/V).
+
+    heads=8 (divisible by the 8-way mesh) lets the Ulysses family join
+    the same record; per-head FLOPs halve via head_dim to keep the
+    record's runtime in its old envelope."""
+    return _comparator_subprocess(
+        ["--comparator", "ring", "--seq-len", "4096",
+         "--heads", "8", "--head-dim", "32", "--iters", "3",
+         "--dtype", "float32"]
+    )
+
+
+def _tree_vs_ring_decode_record():
+    """Tree vs ring vs Ulysses on the DECODE shape (VERDICT r3 item 1) —
+    the reference's entire workload (model.py:140-145: q_len=1, 16 heads ×
+    128), raced over the 8-way emulated mesh with collective counts and
+    bytes-on-wire parsed from each algorithm's compiled SPMD module.
+
+    Two contexts bracket what 1-core emulation can and cannot show:
+
+    - ``ctx_64000`` (the reference's): per-step wall clock is dominated by
+      the serialised local compute (all 8 "devices" timeshare one core),
+      so the ratio reads ~1.0 — collectives priced at memcpy cannot
+      surface the merge's depth difference under 1.6 s of compute.
+    - ``ctx_2048``: local compute shrinks ~30×, the merge chain dominates,
+      and even at memcpy pricing the ring's 14 sequential dispatches lose
+      visibly to the tree's 2 fused collectives.
+
+    The comm accounting (identical at both contexts — the merge payload is
+    context-independent for tree/ring, linear for Ulysses) is the
+    transferable measurement: BASELINE.md's ICI model prices it for real
+    hardware, which is what makes the ≥2×-vs-ring north star falsifiable.
+    """
+    rec = {}
+    for ctx, iters in ((64000, 4), (2048, 6)):
+        rec[f"ctx_{ctx}"] = _comparator_subprocess(
+            ["--comparator", "ring-decode", "--seq-len", str(ctx),
+             "--q-len", "1", "--heads", "16", "--head-dim", "128",
+             "--iters", str(iters), "--dtype", "float32"],
+            timeout=1800,
+        )
+    return rec
 
 
 def _tpu_reachable(timeout_s: int = 240):
@@ -493,6 +541,7 @@ def main() -> None:
             suite["peak_hbm_bytes_process"] = peak
         _save_evidence(suite)
     run("tree_vs_ring_cpu8", _tree_vs_ring_record)
+    run("tree_vs_ring_decode_cpu8", _tree_vs_ring_decode_record)
 
     # The headline metric name carries the backend so a headline-only
     # consumer (the round-over-round BENCH_r{N} comparison) can never

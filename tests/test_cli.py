@@ -74,6 +74,29 @@ class TestCLI:
         if "tree_zigzag" in record:
             assert record["tree_zigzag_speedup_vs_ring"] > 0
 
+    def test_bench_ring_decode_comparator(self):
+        # The decode-shape race (VERDICT r3 item 1): tree vs ring (vs
+        # Ulysses when heads divide) with HLO-measured comm accounting.
+        record, _ = run_cli(
+            "--device", "cpu", "--seq-len", "256", "--q-len", "1",
+            "--heads", "4", "--head-dim", "16", "--dtype", "float32",
+            "--iters", "3", "--warmup", "1",
+            "--mode", "bench", "--comparator", "ring-decode",
+            "--n-virtual-cpu", "4", "--mesh", "seq=4", "--causal",
+            timeout=300,
+        )
+        assert {"tree", "ring", "ulysses", "tree_speedup_vs_ring"} <= set(record)
+        n = 4
+        assert record["tree"]["comm"]["ops"]["all-reduce"]["count"] == 2
+        assert (
+            record["ring"]["comm"]["ops"]["collective-permute"]["count"]
+            == 2 * (n - 1)
+        )
+        assert record["ulysses"]["comm"]["ops"]["all-to-all"]["count"] >= 1
+        for alg in ("tree", "ring", "ulysses"):
+            assert record[alg]["us_per_step"] > 0
+            assert not record[alg]["comm"]["has_loop"]
+
     def test_train_mode(self):
         record, logs = run_cli(
             "--mode", "train", "--device", "cpu", "--seq-len", "64",

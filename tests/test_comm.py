@@ -1,0 +1,136 @@
+"""Communication accounting (``bench/comm.py``): collective counts and
+payload bytes parsed from compiled SPMD modules must match what the
+programs analytically put on the wire — this is the measurement the
+north-star ICI model (``tools/ici_model.py``, BASELINE.md) is priced from.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tree_attention_tpu.bench.comm import (
+    assert_loop_free,
+    collective_stats,
+    _shape_bytes,
+)
+from tree_attention_tpu.parallel import cpu_mesh
+
+
+def test_shape_bytes_parses_arrays_and_tuples():
+    assert _shape_bytes("f32[1,16,1,128]") == 16 * 128 * 4
+    assert _shape_bytes("bf16[8,128]") == 8 * 128 * 2
+    assert _shape_bytes("(f32[8], f32[8,128])") == 8 * 4 + 8 * 128 * 4
+    assert _shape_bytes("s8[4]") == 4
+    assert _shape_bytes("token[]") == 0
+
+
+def test_collective_stats_counts_psum_payload():
+    mesh = cpu_mesh(4)
+
+    def fn(x):
+        return jax.shard_map(
+            lambda x_l: lax.psum(x_l, "seq"),
+            mesh=mesh, in_specs=P("seq"), out_specs=P(None),
+        )(x)
+
+    x = jnp.arange(64, dtype=jnp.float32)
+    st = collective_stats(fn, x)
+    assert st["collective_count"] >= 1
+    assert not st["has_loop"]
+    ar = st["ops"]["all-reduce"]
+    # Per-participant payload: the 16-element local shard... all-reduce's
+    # HLO output is the full reduced tensor each participant holds.
+    assert ar["payload_bytes"] == 16 * 4
+    assert_loop_free(st, "psum")  # must not raise
+
+
+def test_collective_stats_flags_loops():
+    mesh = cpu_mesh(4)
+
+    def fn(x):
+        def inner(x_l):
+            def body(c, _):
+                return lax.psum(c, "seq"), None
+
+            return lax.scan(body, x_l, None, length=3)[0]
+
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=P("seq"), out_specs=P(None),
+            check_vma=False,
+        )(x)
+
+    x = jnp.arange(64, dtype=jnp.float32)
+    st = collective_stats(fn, x)
+    assert st["has_loop"]
+    with pytest.raises(AssertionError, match="while loop"):
+        assert_loop_free(st, "scan-psum")
+
+
+def test_decode_families_measured_payloads():
+    """The three decode algorithms' wire shapes — the numbers BASELINE.md's
+    model quotes: tree 2 context-independent all-reduces; ring 2(N−1)
+    sequential permutes; ulysses a context-proportional all-to-all."""
+    from tree_attention_tpu.parallel import ring_decode, tree_decode, ulysses_decode
+
+    mesh = cpu_mesh(4)
+    B, H, D, T = 1, 4, 32, 256
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, 1, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32)
+
+    def stats(alg):
+        return collective_stats(
+            lambda q_, k_, v_: alg(q_, k_, v_, mesh=mesh, causal=True)[0],
+            q, k, v,
+        )
+
+    tree = stats(tree_decode)
+    assert tree["ops"]["all-reduce"]["count"] == 2
+    # pmax of (B,H,1) f32 + psum of num (B,H,1,D) f32 and den (B,H,1) f32.
+    assert tree["payload_bytes_total"] == B * H * (D + 2) * 4
+
+    ring = stats(ring_decode)
+    n = 4
+    assert ring["ops"]["collective-permute"]["count"] == 2 * (n - 1)
+    # (out, lse) rotated n−1 times: per hop B·H·D f32 + B·H f32.
+    assert ring["payload_bytes_total"] == (n - 1) * (B * H * (D + 1) * 4)
+
+    uly = stats(ulysses_decode)
+    # The KV reshard moves the whole buffer: per-device all-to-all output
+    # is (B, H/n, T, D) per tensor — context-proportional.
+    assert uly["ops"]["all-to-all"]["payload_bytes"] == (
+        2 * B * (H // n) * T * D * 4
+    )
+
+
+def test_ici_model_table_is_monotone_and_crosses():
+    """The priced model must show the claimed structure: parity at small N,
+    ring degrading past the latency crossover, a >=2x point existing."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "ici_model.py",
+    )
+    spec = importlib.util.spec_from_file_location("ici_model", path)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+
+    t8 = m.step_times(8, 1 << 20)
+    t256 = m.step_times(256, 1 << 20)
+    assert t8["ring"] / t8["tree"] < 1.1          # HBM-bound: parity
+    assert t256["ring"] / t256["tree"] >= 2.0     # latency-bound: tree wins
+    # Ulysses is bandwidth-dominated (context-proportional) everywhere.
+    assert t256["ulysses"] > 5 * t256["tree"]
+
+
+def test_shape_bytes_async_start_takes_result_not_sum():
+    # Async '-start' tuples alias the operand beside the result; the
+    # payload is the largest element, while sync fused tuples sum.
+    assert _shape_bytes("(f32[8,128], f32[32,128])", is_start=True) == 32 * 128 * 4
+    assert _shape_bytes("(f32[8,128], f32[32,128])") == (8 + 32) * 128 * 4
+    assert _shape_bytes("(f32[16], f32[16], u32[], u32[])", is_start=True) == 64

@@ -129,3 +129,56 @@ def test_ulysses_rejects_indivisible_seq():
     q, k, v = make_qkv(rng, Tq=66, Tk=66)
     with pytest.raises(ValueError, match="divide"):
         ulysses_attention(q, k, v, mesh=mesh)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_decode_matches_unsharded(causal):
+    """Replicated-Q decode via the KV head-swap: parity with the oracle,
+    including GQA (per-device q head group aligns with its kv heads)."""
+    from tree_attention_tpu.parallel import ulysses_decode
+
+    rng = np.random.default_rng(10)
+    q, k, v = make_qkv(rng, B=1, Hq=8, Hkv=4, Tq=1, Tk=256)
+    mesh = cpu_mesh(4)
+    out, lse = ulysses_decode(q, k, v, mesh=mesh, causal=causal)
+    ref_out, ref_lse = attention_naive(
+        q, k, v, causal=causal, q_offset=256 - 1
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_decode_rejects_indivisible_heads():
+    from tree_attention_tpu.parallel import ulysses_decode
+
+    rng = np.random.default_rng(11)
+    mesh = cpu_mesh(4)
+    q, k, v = make_qkv(rng, Hq=8, Hkv=2, Tq=1, Tk=64)
+    with pytest.raises(ValueError, match="head"):
+        ulysses_decode(q, k, v, mesh=mesh)
+
+
+def test_ulysses_decode_composes_with_head_axis():
+    # The q head-group slice must come from the LOCAL (head-sharded) slice,
+    # not the global head count (r4 review finding).
+    from tree_attention_tpu.parallel import ulysses_decode
+
+    rng = np.random.default_rng(12)
+    q, k, v = make_qkv(rng, B=1, Hq=8, Hkv=8, Tq=1, Tk=64)
+    mesh = cpu_mesh(4, {"model": 2, "seq": 2})
+    out, lse = ulysses_decode(
+        q, k, v, mesh=mesh, causal=True, head_axis="model"
+    )
+    ref_out, ref_lse = attention_naive(q, k, v, causal=True, q_offset=64 - 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_decode_rejects_indivisible_per_shard_heads():
+    from tree_attention_tpu.parallel import ulysses_decode
+
+    rng = np.random.default_rng(13)
+    q, k, v = make_qkv(rng, Hq=4, Hkv=4, Tq=1, Tk=64)
+    mesh = cpu_mesh(8, {"model": 2, "seq": 4})
+    with pytest.raises(ValueError, match="per-shard heads"):
+        ulysses_decode(q, k, v, mesh=mesh, head_axis="model")

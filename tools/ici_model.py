@@ -1,0 +1,124 @@
+"""Price the decode-merge communication on real ICI: the north-star model.
+
+The ≥2×-vs-ring north star (BASELINE.json: tree ≥2× ring tokens/sec/chip at
+1M context) cannot be *measured* on this hardware (one chip; the emulated
+mesh prices collectives at memcpy). This tool makes it *falsifiable*
+instead (VERDICT r3 item 1): every term is either measured in this repo or
+a published hardware constant, so anyone with a pod can check the
+prediction — and any term they refute, refutes the claim.
+
+Terms:
+
+- **Per-chip compute** t_comp = KV_shard_bytes / (roofline_frac · HBM_BW).
+  Decode is HBM-bound; ``roofline_frac`` is MEASURED on the v5e chip
+  (BENCH_r03: 0.88–0.91 across 64k–1M contexts — the kernel streams the
+  shard at ~0.9 of spec bandwidth).
+- **Merge payloads** — MEASURED from each algorithm's compiled SPMD module
+  (``bench.py`` record ``tree_vs_ring_decode_cpu8``, parsed by
+  ``tree_attention_tpu/bench/comm.py``): tree = one pmax (B·H·Tq·4 B) +
+  one psum (B·H·Tq·(D+1)·4 B) = 8 320 B at the reference shape; ring =
+  N−1 sequential hops of 8 256 B; Ulysses = all-to-all of the whole KV
+  shard (context-proportional).
+- **ICI constants** — published v5e figures (assumptions, stated so they
+  can be attacked): per-hop latency ALPHA ≈ 1 µs, per-link one-way
+  bandwidth BETA ≈ 45 GB/s (2D torus). The model is parametric; pass
+  ``--alpha/--beta`` to re-price.
+
+Cost model (latency-dominated regime — the payloads are KB-scale):
+
+    t_tree  = t_comp + ceil(log2 N) · (2·ALPHA + tree_payload/BETA)
+    t_ring  = t_comp + (N−1) · (ALPHA + hop_payload/BETA)
+    t_uly   = t_comp + (N−1)·ALPHA + kv_shard_bytes·(N−1)/N / BETA
+
+(tree: the pmax and psum each run a log-depth stage chain; ring: the hop
+chain is sequential by construction; Ulysses: bandwidth-dominated by the
+KV reshard.) Run ``python tools/ici_model.py`` to print the table that
+BASELINE.md's north-star section quotes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+
+# Published / measured constants (see module docstring).
+HBM_BW = 819e9          # v5e spec HBM bandwidth, B/s
+ROOFLINE_FRAC = 0.88    # measured: BENCH_r03 decode records, 88-91%
+ALPHA = 1e-6            # ICI per-hop latency, s (published figure ~1 us)
+BETA = 4.5e10           # ICI per-link one-way bandwidth, B/s (v5e)
+
+# Reference decode shape (model.py:140-145) with a bf16 cache.
+B, H, TQ, D = 1, 16, 1, 128
+KV_HEADS = 16
+CACHE_BYTES = 2  # bf16
+
+# Merge payloads, corroborated by the compiled-HLO measurement in the
+# tree_vs_ring_decode_cpu8 record (f32 merge state):
+TREE_PAYLOAD = B * H * TQ * 4 + B * H * TQ * (D + 1) * 4   # pmax + psum
+RING_HOP_PAYLOAD = B * H * TQ * (D + 1) * 4                # (out, lse) hop
+
+
+def step_times(n: int, ctx: int, *, alpha: float = ALPHA, beta: float = BETA):
+    """Predicted per-decode-step seconds for each family at N chips."""
+    kv_shard = 2 * (ctx // n) * KV_HEADS * D * CACHE_BYTES
+    t_comp = kv_shard / (ROOFLINE_FRAC * HBM_BW)
+    stages = math.ceil(math.log2(n))
+    t_tree = t_comp + stages * (2 * alpha + TREE_PAYLOAD / beta)
+    t_ring = t_comp + (n - 1) * (alpha + RING_HOP_PAYLOAD / beta)
+    t_uly = t_comp + (n - 1) * alpha + kv_shard * (n - 1) / n / beta
+    return {"comp": t_comp, "tree": t_tree, "ring": t_ring, "ulysses": t_uly}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ctx", type=int, default=1 << 20)
+    p.add_argument("--alpha", type=float, default=ALPHA)
+    p.add_argument("--beta", type=float, default=BETA)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+
+    rows = []
+    crossover = None
+    for n in (8, 16, 32, 64, 128, 256, 512):
+        t = step_times(n, args.ctx, alpha=args.alpha, beta=args.beta)
+        ratio = t["ring"] / t["tree"]
+        rows.append({
+            "chips": n,
+            "t_comp_us": round(t["comp"] * 1e6, 1),
+            "t_tree_us": round(t["tree"] * 1e6, 1),
+            "t_ring_us": round(t["ring"] * 1e6, 1),
+            "t_ulysses_us": round(t["ulysses"] * 1e6, 1),
+            "tree_vs_ring": round(ratio, 2),
+        })
+        if crossover is None and ratio >= 2.0:
+            crossover = n
+    out = {
+        "ctx": args.ctx,
+        "assumptions": {
+            "alpha_s": args.alpha, "beta_Bps": args.beta,
+            "hbm_Bps": HBM_BW, "roofline_frac": ROOFLINE_FRAC,
+            "tree_payload_B": TREE_PAYLOAD,
+            "ring_hop_payload_B": RING_HOP_PAYLOAD,
+        },
+        "rows": rows,
+        "first_n_with_2x": crossover,
+    }
+    if args.json:
+        print(json.dumps(out))
+        return
+    print(f"# ctx={args.ctx}  alpha={args.alpha * 1e6:.1f}us  "
+          f"beta={args.beta / 1e9:.0f}GB/s  "
+          f"tree_payload={TREE_PAYLOAD}B  ring_hop={RING_HOP_PAYLOAD}B")
+    print("| chips | t_comp (µs) | tree (µs) | ring (µs) | ulysses (µs) "
+          "| tree÷ring |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['chips']} | {r['t_comp_us']} | {r['t_tree_us']} "
+              f"| {r['t_ring_us']} | {r['t_ulysses_us']} "
+              f"| {r['tree_vs_ring']}× |")
+    print(f"first N with >=2x: {crossover}")
+
+
+if __name__ == "__main__":
+    main()

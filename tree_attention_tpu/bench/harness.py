@@ -26,13 +26,13 @@ from jax.sharding import Mesh
 from tree_attention_tpu.data import make_qkv, make_qkv_sharded
 from tree_attention_tpu.ops import flash_attention
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ, prune_axes
-from tree_attention_tpu.parallel.ring import ring_attention
+from tree_attention_tpu.parallel.ring import ring_attention, ring_decode
 from tree_attention_tpu.parallel.tree import (
     tree_attention,
     tree_decode,
     tree_decode_q8,
 )
-from tree_attention_tpu.parallel.ulysses import ulysses_attention
+from tree_attention_tpu.parallel.ulysses import ulysses_attention, ulysses_decode
 from tree_attention_tpu.utils.config import RunConfig
 from tree_attention_tpu.utils.logging import get_logger
 from tree_attention_tpu.utils.profiling import TimingStats, device_memory_stats, time_fn
@@ -323,8 +323,108 @@ def bench_compare(cfg: RunConfig, mesh: Mesh) -> Dict[str, Any]:
     return record
 
 
+def bench_decode_compare(cfg: RunConfig, mesh: Mesh) -> Dict[str, Any]:
+    """Tree vs ring (vs Ulysses) on the DECODE shape, with communication
+    accounting — VERDICT r3 item 1.
+
+    Decode (replicated Q of ``q_len`` tokens against a sequence-sharded KV
+    buffer) is the reference's entire workload
+    (``/root/reference/model.py:140-145``) and the shape the tree merge
+    exists for: local compute is identical across the families (same
+    kernel, KV never moves), so the contest is purely the merge's
+    communication. Each algorithm gets:
+
+    - a min-stat **slope** timing (chained steps, the r3 protocol — the
+      3-iter medians of the train comparator wobbled ±4%);
+    - **collective counts and payload bytes per step** parsed from its
+      compiled SPMD module (:func:`tree_attention_tpu.bench.comm
+      .collective_stats`) — the emulated mesh can't price ICI, but it can
+      count exactly what XLA will put on the wire.
+    """
+    from tree_attention_tpu.bench.comm import assert_loop_free, collective_stats
+    from tree_attention_tpu.utils.profiling import time_per_step
+    from jax import lax
+
+    dtype = jnp.dtype(cfg.dtype)
+    q, k, v = make_qkv_sharded(
+        jax.random.PRNGKey(cfg.seed), mesh,
+        batch=cfg.batch, heads=cfg.heads, kv_heads=cfg.resolved_kv_heads(),
+        q_len=cfg.q_len, seq_len=cfg.seq_len, head_dim=cfg.head_dim,
+        dtype=dtype,
+    )
+    axes = prune_axes(mesh, {"data": "data", "model": "model"})
+    n = mesh.shape.get(AXIS_SEQ, 1)
+    kw = dict(
+        mesh=mesh, causal=cfg.causal, impl=cfg.impl,
+        block_size=cfg.block_size,
+        data_axis=axes["data"], head_axis=axes["model"],
+    )
+
+    algorithms = {"tree": tree_decode, "ring": ring_decode}
+    # Ulysses re-shards the head dim; join only when divisibility holds
+    # (same guard shape as the train comparator).
+    if cfg.heads % n == 0 and cfg.resolved_kv_heads() % n == 0:
+        algorithms["ulysses"] = ulysses_decode
+
+    record: Dict[str, Any] = {
+        "workload": _workload(cfg, mesh=dict(mesh.shape)),
+        "n_devices": mesh.size,
+    }
+    per_step: Dict[str, float] = {}
+    for name, alg in algorithms.items():
+        def step(q_, k_, v_, _alg=alg):
+            return _alg(q_, k_, v_, **kw)[0]
+
+        # Decode-step chain: the step's output has q's shape, so it feeds
+        # the next step directly — n dependent steps, scalar-reduced fence.
+        def mk(n_steps):
+            def f(q_, k_, v_):
+                def body(qc, _):
+                    return step(qc, k_, v_).astype(qc.dtype), None
+
+                out = lax.scan(body, q_, None, length=n_steps)[0]
+                return jnp.sum(out.astype(jnp.float32))
+
+            return jax.jit(f)
+
+        per, _, _ = time_per_step(
+            mk, q, k, v, n_small=2, n_large=max(6, cfg.iters),
+            iters=max(cfg.iters, 3), warmup=max(cfg.warmup, 1), stat="min",
+        )
+        comm = collective_stats(step, q, k, v)
+        assert_loop_free(comm, f"{name}_decode")
+        per_step[name] = per
+        record[name] = {
+            "us_per_step": round(per * 1e6, 1),
+            "kv_tokens_per_sec": round(cfg.seq_len / per, 1),
+            "comm": comm,
+        }
+    for name in per_step:
+        if name != "tree":
+            record[f"tree_speedup_vs_{name}"] = round(
+                per_step[name] / per_step["tree"], 3
+            )
+    log.info(
+        "decode comparator (%d-way seq, %d ctx): %s",
+        n, cfg.seq_len,
+        "  ".join(f"{a}={per_step[a] * 1e6:.0f}us" for a in per_step),
+    )
+    return record
+
+
 def run_bench(cfg: RunConfig, mesh: Optional[Mesh] = None) -> Dict[str, Any]:
     """Dispatch on the config; returns the record the CLI prints as JSON."""
+    if cfg.comparator == "ring-decode":
+        if mesh is None:
+            raise ValueError(
+                "the decode comparator needs a mesh (--mesh seq=N)"
+            )
+        if cfg.kv_quant != "none":
+            raise ValueError(
+                "--kv-quant does not apply to the decode comparator "
+                "(all sides run the exact decode path)"
+            )
+        return bench_decode_compare(cfg, mesh)
     if cfg.comparator == "ring":
         if mesh is None:
             raise ValueError("the ring comparator needs a mesh (--mesh seq=N)")

@@ -254,7 +254,21 @@ def merge_partials(outs: jax.Array, lses: jax.Array) -> Tuple[jax.Array, jax.Arr
     w = jnp.exp(lses - m_safe[None])
     den = jnp.sum(w, axis=0)
     num = jnp.sum(outs.astype(jnp.float32) * w[..., None], axis=0)
+    return finalize_merge(num, den, m, outs.dtype)
+
+
+def finalize_merge(
+    num: jax.Array, den: jax.Array, m: jax.Array, out_dtype
+) -> Tuple[jax.Array, jax.Array]:
+    """Normalise reduced safe-softmax state into ``(out, lse)``.
+
+    The ONE definition of the merge epilogue — rows with no visible keys
+    (``den <= 0``) emit 0 / −inf — shared by :func:`merge_partials`, the
+    tree merge (``parallel/tree.py``), and both ring paths
+    (``parallel/ring.py``), so the families' numerics cannot diverge.
+    """
     empty = den <= 0.0
-    out = jnp.where(empty[..., None], 0.0, num / jnp.where(empty, 1.0, den)[..., None])
-    lse = jnp.where(empty, NEG_INF, m + jnp.log(jnp.where(empty, 1.0, den)))
-    return out.astype(outs.dtype), lse.astype(jnp.float32)
+    den_safe = jnp.where(empty, 1.0, den)
+    out = jnp.where(empty[..., None], 0.0, num / den_safe[..., None])
+    lse = jnp.where(empty, NEG_INF, m + jnp.log(den_safe))
+    return out.astype(out_dtype), lse.astype(jnp.float32)

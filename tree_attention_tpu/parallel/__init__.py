@@ -11,8 +11,14 @@ from tree_attention_tpu.parallel.mesh import (  # noqa: F401
     replicate,
     shard_along,
 )
-from tree_attention_tpu.parallel.ring import ring_attention  # noqa: F401
-from tree_attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+from tree_attention_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    ring_decode,
+)
+from tree_attention_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_decode,
+)
 from tree_attention_tpu.parallel.tree import (  # noqa: F401
     shard_zigzag,
     tree_attention,

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 shard_map = jax.shard_map
 
 from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
-from tree_attention_tpu.ops.reference import NEG_INF
+from tree_attention_tpu.ops.reference import NEG_INF, finalize_merge
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
 
@@ -65,6 +65,100 @@ def _merge_step(
     num_new = num * alpha[..., None] + out_b.astype(jnp.float32) * beta[..., None]
     den_new = den * alpha + beta
     return m_new, num_new, den_new
+
+
+def ring_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_position: Optional[int] = None,
+    impl: str = "auto",
+    block_size: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Replicated-Q decode via an N−1-hop ring merge — the O(N) comparator
+    for :func:`tree_decode <tree_attention_tpu.parallel.tree.tree_decode>`'s
+    O(log N) collective merge on the decode shape.
+
+    Decode is the reference's entire workload
+    (``/root/reference/model.py:140-145``: one query token against a long
+    sequence-sharded KV buffer), and the shape where the two families'
+    communication *depth* differs most starkly: the local compute is
+    identical (same kernel, same per-shard ``(out, lse)`` partial — KV
+    never moves in either family), so the whole contest is the merge. Tree
+    merges with one ``pmax`` + one ``psum`` (log-depth, XLA's ICI
+    collectives); this ring instead rotates each device's partial around
+    the ``seq_axis`` with ``lax.ppermute`` — N−1 *sequential* hops, each a
+    full O(B·H·Tq·(D+1)) payload — folding arrivals into the running
+    safe-softmax state (:func:`_merge_step`, the same monoid). Every
+    device sees all N partials after N−1 hops, so the result lands
+    replicated, the same contract tree's psum provides.
+
+    Not a strawman: rotating *partials* is the cheapest honest ring for
+    this shape — rotating KV shards instead (the training-shape pattern)
+    would move O(T/N·Hkv·D) per hop for no benefit when Q is already
+    replicated. The hop loop is unrolled (N is a mesh axis, known at
+    trace time), which both keeps every hop visible to the compiler's
+    latency scheduler and makes the collective count auditable in the
+    compiled HLO (``bench/comm.py``).
+
+    Same signature and sharding contract as ``tree_decode``.
+    """
+    Tk_global = k.shape[2]
+    Tq = q.shape[2]
+    if q_position is None:
+        q_position = Tk_global - Tq
+    n_shards = mesh.shape[seq_axis]
+    if Tk_global % n_shards:
+        raise ValueError(
+            f"global KV length {Tk_global} must divide over {n_shards} "
+            f"'{seq_axis}' shards"
+        )
+    Tk_local = Tk_global // n_shards
+    impl = resolve_impl_for_mesh(impl, mesh)
+
+    q_spec = P(data_axis, head_axis, None, None)
+    kv_spec = P(data_axis, head_axis, seq_axis, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=(q_spec, P(data_axis, head_axis, None)),
+        check_vma=False,
+    )
+    def _sharded(q_l, k_l, v_l):
+        n = lax.axis_size(seq_axis)
+        me = lax.axis_index(seq_axis)
+        out_b, lse_b = flash_attention(
+            q_l, k_l, v_l,
+            causal=causal, scale=scale,
+            q_offset=q_position, kv_offset=me * Tk_local,
+            impl=impl, block_size=block_size,
+        )
+        # Seed the running state with the resident partial, then rotate the
+        # partials: after hop j each device folds the partial originally
+        # computed n−j hops upstream. The monoid is commutative, so every
+        # device converges to the same merged result in n−1 hops.
+        m0 = jnp.full(lse_b.shape, NEG_INF, jnp.float32)
+        num0 = jnp.zeros(out_b.shape, jnp.float32)
+        den0 = jnp.zeros(lse_b.shape, jnp.float32)
+        m, num, den = _merge_step(m0, num0, den0, out_b, lse_b)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        rot_o, rot_l = out_b, lse_b
+        for _ in range(n - 1):
+            rot_o = lax.ppermute(rot_o, seq_axis, perm)
+            rot_l = lax.ppermute(rot_l, seq_axis, perm)
+            m, num, den = _merge_step(m, num, den, rot_o, rot_l)
+        return finalize_merge(num, den, m, q.dtype)
+
+    return _sharded(q, k, v)
 
 
 def ring_attention(
@@ -156,10 +250,6 @@ def ring_attention(
             body, (k_l, v_l, m0, num0, den0), jnp.arange(n - 1)
         )
         m, num, den = attend(k_last, v_last, n - 1, m, num, den)
-        empty = den <= 0.0
-        den_safe = jnp.where(empty, 1.0, den)
-        out = jnp.where(empty[..., None], 0.0, num / den_safe[..., None])
-        lse = jnp.where(empty, NEG_INF, m + jnp.log(den_safe))
-        return out.astype(q.dtype), lse.astype(jnp.float32)
+        return finalize_merge(num, den, m, q.dtype)
 
     return _sharded(q, k, v)

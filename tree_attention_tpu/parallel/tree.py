@@ -45,7 +45,11 @@ from tree_attention_tpu.ops import (
     mesh_platforms,
     resolve_impl_for_mesh,
 )
-from tree_attention_tpu.ops.reference import NEG_INF, merge_partials
+from tree_attention_tpu.ops.reference import (
+    NEG_INF,
+    finalize_merge as _finalize_merge,
+    merge_partials,
+)
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
 
@@ -152,14 +156,6 @@ def _weigh(
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
     w = jnp.exp(lse - m_safe)
     return out.astype(jnp.float32) * w[..., None], w, m
-
-
-def _finalize_merge(num, den, m, out_dtype):
-    empty = den <= 0.0
-    den_safe = jnp.where(empty, 1.0, den)
-    out = jnp.where(empty[..., None], 0.0, num / den_safe[..., None])
-    lse = jnp.where(empty, NEG_INF, m + jnp.log(den_safe))
-    return out.astype(out_dtype), lse.astype(jnp.float32)
 
 
 def _tree_decode_common(

@@ -40,6 +40,104 @@ from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
 
+def ulysses_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_position: Optional[int] = None,
+    impl: str = "auto",
+    block_size: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Replicated-Q decode via the Ulysses head-swap — the third entry in
+    the decode-shape comparator (VERDICT r3 item 1).
+
+    Same contract as :func:`tree_decode
+    <tree_attention_tpu.parallel.tree.tree_decode>` and :func:`ring_decode
+    <tree_attention_tpu.parallel.ring.ring_decode>`: Q ``(B, Hq, Tq, D)``
+    replicated over ``seq_axis``, K/V ``(B, Hkv, Tk, D)`` sequence-sharded
+    along dim 2; returns ``(out, lse)`` replicated.
+
+    The family's communication shape is what makes this entry interesting:
+    from a sequence-sharded cache, each decode step must ``all_to_all``
+    the **entire KV buffer** (seq-sharding → head-sharding, O(Tk·Hkv·D/N)
+    bytes per device) before the purely local full-context kernel runs,
+    then ``all_gather`` the O(B·Hq·Tq·D) head-slice outputs. Tree and ring
+    move O(B·H·Tq·D) *independent of context length*; Ulysses' per-step
+    wire volume grows linearly with the context — the founding claim of
+    the tree merge, made measurable (``bench/comm.py`` counts both).
+    Requires ``Hq % N == 0`` and ``Hkv % N == 0``.
+    """
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk_global = k.shape[1], k.shape[2]
+    if q_position is None:
+        q_position = Tk_global - Tq
+    n = mesh.shape[seq_axis]
+    if Tk_global % n:
+        raise ValueError(
+            f"global KV length {Tk_global} must divide over {n} "
+            f"'{seq_axis}' shards"
+        )
+    # Like ulysses_attention: with a head-parallel axis in play the
+    # all-to-all splits the PER-SHARD head slice, so validate the local
+    # counts, not the global ones.
+    h_shards = mesh.shape[head_axis] if head_axis is not None else 1
+    if Hq % h_shards or Hkv % h_shards:
+        raise ValueError(
+            f"heads (q={Hq}, kv={Hkv}) must divide over {h_shards} "
+            f"'{head_axis}' shards"
+        )
+    if (Hq // h_shards) % n or (Hkv // h_shards) % n:
+        raise ValueError(
+            f"ulysses re-shards the head dim: per-shard heads "
+            f"(q={Hq // h_shards}, kv={Hkv // h_shards}) must divide over "
+            f"{n} '{seq_axis}' shards (use tree/ring decode for head "
+            f"counts smaller than the mesh axis)"
+        )
+    impl = resolve_impl_for_mesh(impl, mesh)
+
+    q_spec = P(data_axis, head_axis, None, None)
+    kv_spec = P(data_axis, head_axis, seq_axis, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=(q_spec, P(data_axis, head_axis, None)),
+        check_vma=False,
+    )
+    def _sharded(q_l, k_l, v_l):
+        me = lax.axis_index(seq_axis)
+        # seq-sharded -> head-sharded: (B, Hkv, Tk/n, D) -> (B, Hkv/n, Tk, D).
+        def to_heads(x):
+            return lax.all_to_all(
+                x, seq_axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        kh, vh = to_heads(k_l), to_heads(v_l)
+        # Q is replicated over seq (its head dim may still be head-sharded):
+        # slice the resident seq-shard's head group from the LOCAL slice.
+        g = q_l.shape[1] // n
+        qh = lax.dynamic_slice_in_dim(q_l, me * g, g, axis=1)
+        out_h, lse_h = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale,
+            q_offset=q_position, kv_offset=0,
+            impl=impl, block_size=block_size,
+        )
+        # Gather the head slices back to the replicated output contract.
+        out = lax.all_gather(out_h, seq_axis, axis=1, tiled=True)
+        lse = lax.all_gather(lse_h, seq_axis, axis=1, tiled=True)
+        return out.astype(q.dtype), lse.astype(jax.numpy.float32)
+
+    return _sharded(q, k, v)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,

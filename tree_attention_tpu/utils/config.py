@@ -65,7 +65,7 @@ class RunConfig:
     # Timing / bench.
     iters: int = 10
     warmup: int = 2
-    comparator: str = "none"  # none | ring (bench mode)
+    comparator: str = "none"  # none | ring (train shape) | ring-decode (bench mode)
 
     # Training mode.
     steps: int = 3
@@ -185,8 +185,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--iters", type=int, default=d.iters)
     p.add_argument("--warmup", type=int, default=d.warmup)
-    p.add_argument("--comparator", choices=["none", "ring"], default=d.comparator,
-                   help="bench mode: also run a comparator and report the ratio")
+    p.add_argument("--comparator", choices=["none", "ring", "ring-decode"],
+                   default=d.comparator,
+                   help="bench mode: race tree against comparators and report "
+                        "ratios — 'ring' on the training shape (fwd+bwd), "
+                        "'ring-decode' on the decode shape (replicated Q, "
+                        "with collective counts and bytes-on-wire from the "
+                        "compiled HLO)")
     p.add_argument("--steps", type=int, default=d.steps, help="train-mode steps")
     p.add_argument("--model-dim", type=int, default=d.model_dim)
     p.add_argument("--n-layers", type=int, default=d.n_layers)
