@@ -48,8 +48,13 @@ fetch costs tens of ms of RPC):
   cancelling every fixed cost (dispatch, RPC, fetch). See
   ``utils.profiling.time_per_step``.
 
-Prints ONE JSON line. Top-level keys keep the round-1 headline contract
-{"metric", "value", "unit", "vs_baseline"}; the full suite rides in "suite".
+Prints TWO JSON lines (r4): first the full record — top-level keys keep the
+round-1 headline contract {"metric", "value", "unit", "vs_baseline"} with
+the full suite in "suite" — then a compact (<1 KB) summary as the LAST
+line, carrying the same headline keys plus backend, commit, and one key
+figure per record. The driver captures a bounded stdout tail, which
+truncated the r3 single-line format mid-object; the summary line is the
+one guaranteed to survive and parse.
 Decode records report achieved HBM bandwidth and percent of the v5e roofline
 (819 GB/s) — the defensible number; vs_baseline is a smoke datapoint against
 the reference's buggy CPU run.
@@ -329,12 +334,61 @@ def _tree_vs_ring_record():
 
     heads=8 (divisible by the 8-way mesh) lets the Ulysses family join
     the same record; per-head FLOPs halve via head_dim to keep the
-    record's runtime in its old envelope."""
-    return _comparator_subprocess(
-        ["--comparator", "ring", "--seq-len", "4096",
-         "--heads", "8", "--head-dim", "32", "--iters", "3",
-         "--dtype", "float32"]
-    )
+    record's runtime in its old envelope.
+
+    VERDICT r3 item 6: the comparator now times with a min-stat estimator
+    (see ``bench_train_attention`` — single-step min on the emulated
+    mesh, slope on TPU meshes), runs the 4k shape TWICE in separate
+    processes and reports the ratio spread (the r3 3-iter medians wobbled
+    1.013–1.05 across same-HEAD runs), and adds a second shape — T=8192,
+    GQA-4 (8 q heads / 2 KV heads) — where only tree/ring (and zigzag)
+    race: Ulysses' head-divisibility (2 KV heads over an 8-way mesh)
+    excludes it, which is itself the point (SURVEY §2.4 — tree serves
+    GQA where Ulysses cannot)."""
+    shape_4k = ["--comparator", "ring", "--seq-len", "4096",
+                "--heads", "8", "--head-dim", "32", "--iters", "3",
+                "--dtype", "float32"]
+    rec = _comparator_subprocess(shape_4k)
+    # Later sub-runs must not discard this one: each is minutes of 1-core
+    # compute, so a flaky rerun/gqa subprocess degrades to an error note
+    # instead of erasing the record.
+    try:
+        rerun = _comparator_subprocess(shape_4k)
+        spread = abs(
+            rerun["tree_speedup_vs_ring"] - rec["tree_speedup_vs_ring"]
+        ) / rec["tree_speedup_vs_ring"]
+        rec["second_run"] = {
+            k: v for k, v in rerun.items() if k.endswith("speedup_vs_ring")
+        }
+        rec["ratio_spread_pct"] = round(spread * 100, 2)
+    except Exception as e:
+        rec["second_run"] = {"error": f"{type(e).__name__}: {e}"}
+    # 8 heads GQA-4 at head_dim 16 keeps the 8k shape's serialised-CPU
+    # cost in budget (a 16h×32D variant measured >30 min of 1-core time):
+    # the comparison isolates the communication pattern, and head
+    # count/width only scale the identical local compute both sides run.
+    # kv_heads=2 still excludes Ulysses (2 % 8 != 0) — the GQA point.
+    try:
+        rec["gqa_8k"] = _comparator_subprocess(
+            ["--comparator", "ring", "--seq-len", "8192",
+             "--heads", "8", "--kv-heads", "2", "--head-dim", "16",
+             "--iters", "3", "--dtype", "float32"],
+            timeout=2400,
+        )
+    except Exception as e:
+        rec["gqa_8k"] = {"error": f"{type(e).__name__}: {e}"}
+    return rec
+
+
+def _git_commit():
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:
+        return ""
 
 
 def _tree_vs_ring_decode_record():
@@ -360,12 +414,17 @@ def _tree_vs_ring_decode_record():
     """
     rec = {}
     for ctx, iters in ((64000, 4), (2048, 6)):
-        rec[f"ctx_{ctx}"] = _comparator_subprocess(
-            ["--comparator", "ring-decode", "--seq-len", str(ctx),
-             "--q-len", "1", "--heads", "16", "--head-dim", "128",
-             "--iters", str(iters), "--dtype", "float32"],
-            timeout=1800,
-        )
+        # Per-context isolation: one context's failure must not erase the
+        # other's ~10 min of serialised 1-core compute.
+        try:
+            rec[f"ctx_{ctx}"] = _comparator_subprocess(
+                ["--comparator", "ring-decode", "--seq-len", str(ctx),
+                 "--q-len", "1", "--heads", "16", "--head-dim", "128",
+                 "--iters", str(iters), "--dtype", "float32"],
+                timeout=1800,
+            )
+        except Exception as e:
+            rec[f"ctx_{ctx}"] = {"error": f"{type(e).__name__}: {e}"}
     return rec
 
 
@@ -413,7 +472,8 @@ _EVIDENCE_PATH = os.environ.get(
 )
 _TPU_RECORDS = ("decode_64k", "decode_gqa_128k", "decode_gqa_1m",
                 "decode_mha_1m", "decode_64k_q8", "decode_64k_q8q",
-                "train_fwd_bwd", "train_fwd_bwd_16k")
+                "train_fwd_bwd", "train_fwd_bwd_16k",
+                "train_fwd_bwd_32k", "train_fwd_bwd_64k")
 
 
 def _save_evidence(suite) -> None:
@@ -426,14 +486,7 @@ def _save_evidence(suite) -> None:
     item 5 / weak item 1)."""
     import time
 
-    try:
-        commit = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        ).stdout.strip()
-    except Exception:
-        commit = ""
+    commit = _git_commit()
     stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
     try:
         with open(_EVIDENCE_PATH, "a") as f:
@@ -529,6 +582,12 @@ def main() -> None:
         # BASELINE config 2's shape (seq 16384): MFU progress toward the
         # north star is tracked round over round at this length too.
         run("train_fwd_bwd_16k", _train_record, 16384, 2, 8)
+        # The longest single-chip-feasible causal training shapes (VERDICT
+        # r3 item 5): 32k and 64k anchor the config-5 scaling trend this
+        # hardware can produce. Short chains — the steps are 4x/16x the
+        # 16k step's work, so the slope base is already >100 ms.
+        run("train_fwd_bwd_32k", _train_record, 32768, 2, 6)
+        run("train_fwd_bwd_64k", _train_record, 65536, 1, 3)
         # Allocator peak has no reset API, so a per-workload peak is not
         # observable in one process — record the process-lifetime peak once
         # (set by the largest workload, the 1M-context decode). Per-workload
@@ -568,6 +627,64 @@ def main() -> None:
     if not on_tpu:
         record["backend"] = suite["backend"]
     print(json.dumps(record))
+    # The driver captures a bounded stdout TAIL; the full record above can
+    # truncate mid-object there (BENCH_r03 "parsed": null — VERDICT r3
+    # item 4). A compact summary printed LAST always survives the tail and
+    # carries the headline, backend provenance, and one key figure per
+    # record; the full suite remains in the line above for humans and the
+    # evidence file.
+    print(json.dumps(_summary_line(record, suite)))
+
+
+def _summarize_record(name, rec):
+    """One key figure per suite record for the compact summary line."""
+    if not isinstance(rec, dict):
+        return None
+    if "error" in rec:
+        return "error"
+    if "skipped" in rec:
+        return "skipped"
+    out = {}
+    if "pct_hbm_roofline" in rec:
+        out["pct_roofline"] = rec["pct_hbm_roofline"]
+    for pass_name in ("fwd", "fwd_bwd"):
+        if pass_name in rec and "mfu_pct" in rec[pass_name]:
+            out[f"{pass_name}_mfu_pct"] = rec[pass_name]["mfu_pct"]
+    for key in ("tree_speedup_vs_ring", "tree_zigzag_speedup_vs_ring",
+                "ratio_spread_pct"):
+        if key in rec:
+            out[key] = rec[key]
+    if "gqa_8k" in rec and "tree_speedup_vs_ring" in rec["gqa_8k"]:
+        out["gqa_8k_vs_ring"] = rec["gqa_8k"]["tree_speedup_vs_ring"]
+        if "tree_zigzag_speedup_vs_ring" in rec["gqa_8k"]:
+            out["gqa_8k_zigzag_vs_ring"] = (
+                rec["gqa_8k"]["tree_zigzag_speedup_vs_ring"]
+            )
+    if name.startswith("tree_vs_ring_decode"):
+        for ctx, sub in rec.items():
+            if isinstance(sub, dict) and "tree_speedup_vs_ring" in sub:
+                out[f"{ctx}_vs_ring"] = sub["tree_speedup_vs_ring"]
+    if rec.get("measured_earlier_this_round"):
+        out["replayed"] = True
+    return out or None
+
+
+def _summary_line(record, suite):
+    commit = _git_commit()
+    records = {}
+    for name, rec in record["suite"].items():
+        s = _summarize_record(name, rec)
+        if s is not None:
+            records[name] = s
+    return {
+        "metric": record["metric"],
+        "value": record["value"],
+        "unit": record["unit"],
+        "vs_baseline": record["vs_baseline"],
+        "backend": suite.get("backend", "tpu"),
+        "commit": commit,
+        "records": records,
+    }
 
 
 if __name__ == "__main__":

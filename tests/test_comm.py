@@ -134,3 +134,48 @@ def test_shape_bytes_async_start_takes_result_not_sum():
     assert _shape_bytes("(f32[8,128], f32[32,128])", is_start=True) == 32 * 128 * 4
     assert _shape_bytes("(f32[8,128], f32[32,128])") == (8 + 32) * 128 * 4
     assert _shape_bytes("(f32[16], f32[16], u32[], u32[])", is_start=True) == 64
+
+
+def test_bench_summary_line_is_compact_and_parseable():
+    """bench.py must end with a small self-sufficient JSON line (the
+    driver's bounded stdout tail truncated the r3 single-line format)."""
+    import importlib.util
+    import json as _json
+    import os as _os
+
+    path = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "bench.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+
+    suite = {
+        "backend": "cpu_fallback (probe skipped)",
+        "decode_64k": {"pct_hbm_roofline": 88.1, "us_per_step": 711.0,
+                       "kv_tokens_per_sec": 9.0e7,
+                       "measured_earlier_this_round": True},
+        "train_fwd_bwd_16k": {"fwd": {"mfu_pct": 63.1},
+                              "fwd_bwd": {"mfu_pct": 75.6}},
+        "tree_vs_ring_cpu8": {"tree_speedup_vs_ring": 1.013,
+                              "tree_zigzag_speedup_vs_ring": 1.248},
+        "tree_vs_ring_decode_cpu8": {
+            "ctx_64000": {"tree_speedup_vs_ring": 0.97},
+            "ctx_2048": {"tree_speedup_vs_ring": 1.4},
+        },
+        "decode_gqa_1m": {"skipped": "tpu unreachable"},
+        "train_fwd_bwd": {"error": "RuntimeError: boom"},
+    }
+    record = {"metric": "m", "value": 1.0, "unit": "tokens/sec",
+              "vs_baseline": 2.0, "suite": suite}
+    line = _json.dumps(b._summary_line(record, suite))
+    assert len(line) < 2000  # survives any bounded tail
+    parsed = _json.loads(line)
+    assert parsed["backend"].startswith("cpu_fallback")
+    assert parsed["records"]["decode_64k"]["replayed"] is True
+    assert parsed["records"]["train_fwd_bwd_16k"]["fwd_mfu_pct"] == 63.1
+    assert parsed["records"]["tree_vs_ring_decode_cpu8"]["ctx_2048_vs_ring"] == 1.4
+    assert parsed["records"]["decode_gqa_1m"] == "skipped"
+    assert parsed["records"]["train_fwd_bwd"] == "error"
+    assert {"metric", "value", "unit", "vs_baseline", "commit"} <= set(parsed)

@@ -1,0 +1,156 @@
+"""Round-4 chip experiments: close the fwd-MFU gap (VERDICT r3 item 3).
+
+The forward measured 63.1% MFU at 16k while its own backward sustains
+75.6% — and r3 already killed the two obvious suspects (lax.cond interior
+skip: regression; scale-fold: neutral — measurements/r3/README.md). The
+r4 hypothesis set attacks the remaining levers the verdict names:
+
+1. **Q-tile depth / KV-tile width trade at constant VMEM.** The per-tile
+   epilogue (max/exp/sum — VPU) costs O(bq·bk) against O(bq·bk·D) MXU
+   work, so its *relative* cost is tile-shape-independent; but the fixed
+   per-tile cost (grid step, DMA issue, scratch rotate) and the pipeline
+   depth are not. Sweep (bq, bk) at p-transient parity (bq·bk·4 ≈ 8 MB):
+   (1024, 2048) [the r3 winner], (2048, 1024), (512, 4096), (256, 8192),
+   plus (1024, 4096) and (2048, 2048) to probe the VMEM ceiling.
+2. **Longer sequences amortise better** — measure the same sweep at 32k,
+   and spot-check 64k fwd+bwd feasibility before bench.py relies on it.
+
+Run (one tunnel client, nothing else on the host):
+    python tools/experiments_r4.py > measurements/r4/experiments_r4.jsonl
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BF16_PEAK = 197e12
+
+
+def log(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def qkv(T, H=16, D=128):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (
+        jax.random.normal(kq, (1, H, T, D), jnp.bfloat16),
+        jax.random.normal(kk, (1, H, T, D), jnp.bfloat16),
+        jax.random.normal(kv, (1, H, T, D), jnp.bfloat16),
+    )
+
+
+def chain(step, n):
+    def f(q, k, v):
+        def body(qc, _):
+            return step(qc, k, v).astype(qc.dtype), None
+
+        out = lax.scan(body, q, None, length=n)[0]
+        return jnp.sum(out.astype(jnp.float32))
+
+    return jax.jit(f)
+
+
+def measure(step, q, k, v, ns, nl, iters=5):
+    from tree_attention_tpu.utils.profiling import time_per_step
+
+    per, _, _ = time_per_step(
+        lambda n: chain(step, n), q, k, v, n_small=ns, n_large=nl,
+        iters=iters, warmup=1, stat="min",
+    )
+    return per
+
+
+def live_tiles(T, bq, bk):
+    import numpy as np
+
+    n_q, n_k = -(-T // bq), -(-T // bk)
+    qi = np.arange(n_q)[:, None]
+    ki = np.arange(n_k)[None, :]
+    return int(((qi * bq + bq - 1) >= (ki * bk)).sum())
+
+
+def fwd_mfu(T, bq, bk, per):
+    flops = 2 * 2 * bq * bk * 128 * 16 * live_tiles(T, bq, bk)
+    return flops / per / BF16_PEAK * 100
+
+
+def main():
+    from tree_attention_tpu.ops import flash_attention
+
+    log({"backend": jax.default_backend(),
+         "device": str(jax.devices()[0])})
+
+    # --- fwd tile sweep, 16k and 32k ---
+    for T, ns, nl in ((16384, 4, 16), (32768, 2, 8)):
+        q, k, v = qkv(T)
+        for bq, bk in ((1024, 2048), (2048, 1024), (512, 4096),
+                       (256, 8192), (1024, 4096), (2048, 2048)):
+            def fwd(q_, k_, v_):
+                return flash_attention(
+                    q_, k_, v_, causal=True, impl="pallas",
+                    block_q=bq, block_size=bk, custom_vjp=False,
+                )[0]
+
+            try:
+                per = measure(fwd, q, k, v, ns, nl)
+                log({"exp": "fwd_tiles", "T": T, "bq": bq, "bk": bk,
+                     "us": round(per * 1e6, 1),
+                     "mfu_pct": round(fwd_mfu(T, bq, bk, per), 1)})
+            except Exception as e:
+                log({"exp": "fwd_tiles", "T": T, "bq": bq, "bk": bk,
+                     "error": f"{type(e).__name__}: {str(e)[:200]}"})
+        del q, k, v
+
+    # --- fwd+bwd spot-check at 16k for the sweep's top tiles (the bwd
+    # keeps its VMEM-capped bq; block_q here drives the fwd only) ---
+    T = 16384
+    q, k, v = qkv(T)
+    for bq, bk in ((1024, 2048), (512, 4096), (256, 8192)):
+        def both(q_, k_, v_):
+            def loss(q__):
+                o, _ = flash_attention(
+                    q__, k_, v_, causal=True, impl="pallas",
+                    block_q=bq, block_size=bk,
+                )
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            return jax.grad(loss)(q_)
+
+        try:
+            per = measure(both, q, k, v, 2, 8)
+            log({"exp": "fwd_bwd_tiles", "T": T, "bq": bq, "bk": bk,
+                 "us": round(per * 1e6, 1)})
+        except Exception as e:
+            log({"exp": "fwd_bwd_tiles", "T": T, "bq": bq, "bk": bk,
+                 "error": f"{type(e).__name__}: {str(e)[:200]}"})
+    del q, k, v
+
+    # --- 64k fwd+bwd feasibility (bench.py train_fwd_bwd_64k depends on
+    # this fitting in HBM) ---
+    T = 65536
+    q, k, v = qkv(T)
+
+    def both64(q_, k_, v_):
+        def loss(q__, k__, v__):
+            o, _ = flash_attention(q__, k__, v__, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+        return dq + dk + dv
+
+    try:
+        per = measure(both64, q, k, v, 1, 3, iters=3)
+        log({"exp": "fwd_bwd_64k_feasible", "T": T,
+             "us": round(per * 1e6, 1)})
+    except Exception as e:
+        log({"exp": "fwd_bwd_64k_feasible", "T": T,
+             "error": f"{type(e).__name__}: {str(e)[:300]}"})
+
+
+if __name__ == "__main__":
+    main()
