@@ -666,6 +666,13 @@ def _summarize_record(name, rec):
                 out[f"{ctx}_vs_ring"] = sub["tree_speedup_vs_ring"]
     if rec.get("measured_earlier_this_round"):
         out["replayed"] = True
+    if not out and any(
+        isinstance(sub, dict) and "error" in sub for sub in rec.values()
+    ):
+        # All figures failed in nested sub-runs: surface that in the
+        # summary rather than silently omitting the record (a missing key
+        # would read as "not run").
+        return "error"
     return out or None
 
 

@@ -22,3 +22,20 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Cap cumulative executable/tracing state across the suite.
+
+    Most tests jit fresh lambdas/closures, each a permanent entry in the
+    global jit cache; by ~370 tests the accumulated executables crashed
+    the process (deterministic SIGSEGV mid-suite at test_pallas_decode,
+    observed 2026-07-31 — passes in any smaller combination). Cross-file
+    cache sharing is negligible, so dropping caches at module teardown
+    bounds the growth at the cost of a few intra-file recompiles.
+    """
+    yield
+    jax.clear_caches()

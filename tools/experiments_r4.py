@@ -65,17 +65,12 @@ def measure(step, q, k, v, ns, nl, iters=5):
     return per
 
 
-def live_tiles(T, bq, bk):
-    import numpy as np
-
-    n_q, n_k = -(-T // bq), -(-T // bk)
-    qi = np.arange(n_q)[:, None]
-    ki = np.arange(n_k)[None, :]
-    return int(((qi * bq + bq - 1) >= (ki * bk)).sum())
-
-
 def fwd_mfu(T, bq, bk, per):
-    flops = 2 * 2 * bq * bk * 128 * 16 * live_tiles(T, bq, bk)
+    # The ONE live-tile FLOP count (bench.py's _live_tiles — the same
+    # tile_live predicate the kernels gate compute on).
+    from bench import _live_tiles
+
+    flops = 2 * 2 * bq * bk * 128 * 16 * _live_tiles(T, T, bq, bk)
     return flops / per / BF16_PEAK * 100
 
 
@@ -106,25 +101,35 @@ def main():
                      "error": f"{type(e).__name__}: {str(e)[:200]}"})
         del q, k, v
 
-    # --- fwd+bwd spot-check at 16k for the sweep's top tiles (the bwd
-    # keeps its VMEM-capped bq; block_q here drives the fwd only) ---
+    # --- fwd+bwd spot-check at 16k for the sweep's top tiles. The bwd
+    # kernels are pinned at their VMEM-capped defaults via the vjp's
+    # explicit block_q_bwd (the public API threads an explicit block_q to
+    # BOTH passes, which would both exceed the bwd VMEM cap at bq=1024
+    # and confound the fwd-tile comparison), and all three grads are
+    # computed and folded — grad-wrt-q alone lets XLA dead-code-eliminate
+    # the dKV kernel (~5 of the 9 backward matmul passes). ---
+    from tree_attention_tpu.ops.tuning import default_block_q_bwd
+    from tree_attention_tpu.ops.vjp import flash_attention_vjp
+
     T = 16384
     q, k, v = qkv(T)
+    bq_bwd = default_block_q_bwd(T, T)
     for bq, bk in ((1024, 2048), (512, 4096), (256, 8192)):
         def both(q_, k_, v_):
-            def loss(q__):
-                o, _ = flash_attention(
-                    q__, k_, v_, causal=True, impl="pallas",
-                    block_q=bq, block_size=bk,
+            def loss(q__, k__, v__):
+                o, _ = flash_attention_vjp(
+                    q__, k__, v__, causal=True, impl="pallas",
+                    block_q=bq, block_q_bwd=bq_bwd, block_size=bk,
                 )
                 return jnp.sum(o.astype(jnp.float32) ** 2)
 
-            return jax.grad(loss)(q_)
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+            return dq + dk + dv
 
         try:
             per = measure(both, q, k, v, 2, 8)
             log({"exp": "fwd_bwd_tiles", "T": T, "bq": bq, "bk": bk,
-                 "us": round(per * 1e6, 1)})
+                 "bq_bwd": bq_bwd, "us": round(per * 1e6, 1)})
         except Exception as e:
             log({"exp": "fwd_bwd_tiles", "T": T, "bq": bq, "bk": bk,
                  "error": f"{type(e).__name__}: {str(e)[:200]}"})
