@@ -126,6 +126,10 @@ def test_ici_model_table_is_monotone_and_crosses():
     assert t256["ring"] / t256["tree"] >= 2.0     # latency-bound: tree wins
     # Ulysses is bandwidth-dominated (context-proportional) everywhere.
     assert t256["ulysses"] > 5 * t256["tree"]
+    # GQA shrinks per-chip compute but not the merge payload, so the
+    # crossover pulls in (BASELINE.md: N >~ 64 for a 4-KV-head cache).
+    g64 = m.step_times(64, 1 << 20, kv_heads=4)
+    assert g64["ring"] / g64["tree"] >= 2.0
 
 
 def test_shape_bytes_async_start_takes_result_not_sum():

@@ -50,18 +50,21 @@ BETA = 4.5e10           # ICI per-link one-way bandwidth, B/s (v5e)
 
 # Reference decode shape (model.py:140-145) with a bf16 cache.
 B, H, TQ, D = 1, 16, 1, 128
-KV_HEADS = 16
 CACHE_BYTES = 2  # bf16
 
 # Merge payloads, corroborated by the compiled-HLO measurement in the
-# tree_vs_ring_decode_cpu8 record (f32 merge state):
+# tree_vs_ring_decode_cpu8 record (f32 merge state). Note both scale with
+# the QUERY head count only — a GQA cache shrinks t_comp 4×–8× while the
+# merge payload is unchanged, which pulls the tree-vs-ring crossover to
+# smaller N (the merge's relative weight grows).
 TREE_PAYLOAD = B * H * TQ * 4 + B * H * TQ * (D + 1) * 4   # pmax + psum
 RING_HOP_PAYLOAD = B * H * TQ * (D + 1) * 4                # (out, lse) hop
 
 
-def step_times(n: int, ctx: int, *, alpha: float = ALPHA, beta: float = BETA):
+def step_times(n: int, ctx: int, *, alpha: float = ALPHA, beta: float = BETA,
+               kv_heads: int = H):
     """Predicted per-decode-step seconds for each family at N chips."""
-    kv_shard = 2 * (ctx // n) * KV_HEADS * D * CACHE_BYTES
+    kv_shard = 2 * (ctx // n) * kv_heads * D * CACHE_BYTES
     t_comp = kv_shard / (ROOFLINE_FRAC * HBM_BW)
     stages = math.ceil(math.log2(n))
     t_tree = t_comp + stages * (2 * alpha + TREE_PAYLOAD / beta)
@@ -75,13 +78,17 @@ def main() -> None:
     p.add_argument("--ctx", type=int, default=1 << 20)
     p.add_argument("--alpha", type=float, default=ALPHA)
     p.add_argument("--beta", type=float, default=BETA)
+    p.add_argument("--kv-heads", type=int, default=H,
+                   help="KV head count (GQA shrinks per-chip compute but "
+                        "not the merge payload: earlier crossover)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args()
 
     rows = []
     crossover = None
     for n in (8, 16, 32, 64, 128, 256, 512):
-        t = step_times(n, args.ctx, alpha=args.alpha, beta=args.beta)
+        t = step_times(n, args.ctx, alpha=args.alpha, beta=args.beta,
+                       kv_heads=args.kv_heads)
         ratio = t["ring"] / t["tree"]
         rows.append({
             "chips": n,
