@@ -472,6 +472,7 @@ _EVIDENCE_PATH = os.environ.get(
 )
 _TPU_RECORDS = ("decode_64k", "decode_gqa_128k", "decode_gqa_1m",
                 "decode_mha_1m", "decode_64k_q8", "decode_64k_q8q",
+                "decode_gqa_256k_q8q",
                 "train_fwd_bwd", "train_fwd_bwd_16k",
                 "train_fwd_bwd_32k", "train_fwd_bwd_64k")
 
@@ -578,6 +579,11 @@ def main() -> None:
         run("decode_64k_q8", _decode_q8_record, 16, 16, 64000, 32, 128)
         run("decode_64k_q8q", _decode_q8_record, 16, 16, 64000, 32, 128,
             q_quant=True)
+        # BASELINE config 4's class (GQA decode against a long cache) over
+        # the quantized path: 32q/4kv at 256k ctx, int8-MXU kernel through
+        # the product dispatcher.
+        run("decode_gqa_256k_q8q", _decode_q8_record, 32, 4, 1 << 18, 32,
+            128, q_quant=True)
         run("train_fwd_bwd", _train_record)
         # BASELINE config 2's shape (seq 16384): MFU progress toward the
         # north star is tracked round over round at this length too.
