@@ -356,3 +356,10 @@ class TestCLI:
             capture_output=True, text=True, timeout=60, cwd=REPO,
         )
         assert proc.returncode != 0
+
+    def test_decode_timing_suspect_flag_absent_on_honest_runs(self):
+        # The physical-HBM-floor guard must stay quiet on a fenced backend
+        # (CPU fences correctly; only an unfenced transport can read
+        # below the floor).
+        record, _ = run_cli(*TINY)
+        assert "timing_suspect" not in record

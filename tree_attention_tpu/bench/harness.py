@@ -204,6 +204,26 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
     )
     if quant:
         workload["impl"] = "pallas_decode"  # what actually ran
+    # Decode must stream every KV byte, so there is a physical floor on
+    # the step time. A reading below it means the completion fence did not
+    # actually fence (observed on tunneled TPU transports, where
+    # block_until_ready can resolve mid-execution) — flag it rather than
+    # report impossible tokens/sec. bench.py's records avoid this class of
+    # artifact entirely via fetch-fenced slope timing.
+    kv_bytes = (
+        2 * cfg.batch * cfg.seq_len * cfg.resolved_kv_heads() * cfg.head_dim
+        * (1 if quant else jnp.dtype(cfg.dtype).itemsize)
+    ) // (1 if mesh is None else mesh.shape.get(AXIS_SEQ, 1))
+    suspect = {}
+    if stats.median < kv_bytes / 5e12:  # no chip streams KV at 5 TB/s
+        suspect["timing_suspect"] = (
+            "median below the physical HBM floor for this workload; the "
+            "completion fence likely did not fence (tunneled transport?) "
+            "— use --mode bench / bench.py (slope protocol) for honest "
+            "numbers"
+        )
+        log.warning("decode timing below the physical HBM floor: %s",
+                    suspect["timing_suspect"])
     return BenchResult(
         name=name,
         workload=workload,
@@ -212,6 +232,7 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
         flops_per_sec=flops / stats.median,
         n_devices=n_devices,
         peak_hbm_bytes=_peak_hbm(),
+        extra=suspect,
     )
 
 
