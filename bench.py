@@ -70,13 +70,45 @@ BF16_PEAK = 197e12  # TPU v5e spec bf16 peak, FLOP/s
 BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
 
 
+def _slope_record_fields(slope, kv_bytes):
+    """Shared honest-number tail for decode records: per-step from the
+    min-over-cycles slope, the cycle slopes and spread as the record's own
+    error bar, and a symmetric plausibility guard (VERDICT r4 item 1 — the
+    r4 driver capture read decode_64k 33 points below the same commit's
+    earlier run with nothing in the record to say which was wrong).
+    """
+    per_step = slope.per_step
+    bw = kv_bytes / per_step
+    fields = {
+        "us_per_step": round(per_step * 1e6, 1),
+        "hbm_bytes_per_sec": round(bw, 1),
+        "pct_hbm_roofline": round(bw / HBM_ROOFLINE * 100, 1),
+        "slope_cycles_us": [round(s * 1e6, 2) for s in slope.slopes],
+        "slope_spread_pct": round(slope.spread_pct, 1),
+    }
+    if bw > 1.05 * HBM_ROOFLINE:
+        fields["timing_suspect"] = (
+            "implied bandwidth above the HBM spec — the fetch fence did "
+            "not fence; discard this record"
+        )
+    elif slope.spread_pct > 15:
+        # Additive-noise model: only an inflated slope is possible, so the
+        # min is still the honest estimate — but a wide spread says the
+        # window was contended and the min may itself be an upper bound.
+        fields["timing_note"] = (
+            f"cycle slopes spread {slope.spread_pct:.0f}%: contended "
+            "window; per-step is the min cycle (noise is additive)"
+        )
+    return per_step, fields
+
+
 def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from tree_attention_tpu.ops import flash_attention
-    from tree_attention_tpu.utils.profiling import time_per_step
+    from tree_attention_tpu.utils.profiling import slope_per_step
 
     D = 128
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -110,9 +142,9 @@ def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
     errors = {}
     for impl in ("auto", "naive", "blockwise"):
         try:
-            per_step, _, _ = time_per_step(
+            slope = slope_per_step(
                 make_chain(impl), q, k, v, n_small=n_small, n_large=n_large,
-                iters=5, warmup=1, stat="min",
+                iters=5, warmup=1, stat="min", repeats=3,
             )
             break
         except Exception as e:
@@ -121,16 +153,14 @@ def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
         raise RuntimeError(f"all impls failed: {errors}")
 
     kv_bytes = 2 * T * Hkv * D * 2
-    bw = kv_bytes / per_step
+    per_step, fields = _slope_record_fields(slope, kv_bytes)
     rec = {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
                      "head_dim": D, "dtype": "bfloat16", "q_len": 1,
                      "causal": True},
         "impl": impl,
-        "us_per_step": round(per_step * 1e6, 1),
         "kv_tokens_per_sec": round(T / per_step, 1),
-        "hbm_bytes_per_sec": round(bw, 1),
-        "pct_hbm_roofline": round(bw / HBM_ROOFLINE * 100, 1),
+        **fields,
     }
     if errors:
         rec["fallback_from"] = errors
@@ -154,7 +184,7 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large, q_quant=False):
 
     from tree_attention_tpu.models.decode import decode_attention
     from tree_attention_tpu.ops.pallas_decode import quantize_kv_channelwise
-    from tree_attention_tpu.utils.profiling import time_per_step
+    from tree_attention_tpu.utils.profiling import slope_per_step
 
     quant_kernel = "q8q" if q_quant else "q8"
 
@@ -178,21 +208,19 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large, q_quant=False):
 
         return jax.jit(f)
 
-    per_step, _, _ = time_per_step(
+    slope = slope_per_step(
         mk, q, k_q, v_q, n_small=n_small, n_large=n_large, iters=5, warmup=1,
-        stat="min",
+        stat="min", repeats=3,
     )
     kv_bytes = 2 * T * Hkv * D  # int8: one byte per element
-    bw = kv_bytes / per_step
+    per_step, fields = _slope_record_fields(slope, kv_bytes)
     return {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
                      "head_dim": D, "kv_dtype": "int8", "q_len": 1,
                      "causal": True,
                      "q_dtype": "int8(row)" if q_quant else "bfloat16"},
-        "us_per_step": round(per_step * 1e6, 1),
         "kv_tokens_per_sec": round(T / per_step, 1),
-        "hbm_bytes_per_sec": round(bw, 1),
-        "pct_hbm_roofline": round(bw / HBM_ROOFLINE * 100, 1),
+        **fields,
     }
 
 
@@ -227,7 +255,7 @@ def _train_record(T=4096, n_small=16, n_large=64):
 
     from tree_attention_tpu.ops import flash_attention
     from tree_attention_tpu.ops.tuning import default_block_q, default_block_size
-    from tree_attention_tpu.utils.profiling import time_per_step
+    from tree_attention_tpu.utils.profiling import slope_per_step
 
     B, H, D = 1, 16, 128
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
@@ -267,14 +295,15 @@ def _train_record(T=4096, n_small=16, n_large=64):
         dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
         return dq + dk + dv
 
-    per_fwd, _, _ = time_per_step(
+    s_fwd = slope_per_step(
         chain(fwd_step), q, k, v, n_small=n_small, n_large=n_large,
-        iters=5, warmup=1, stat="min",
+        iters=5, warmup=1, stat="min", repeats=2,
     )
-    per_both, _, _ = time_per_step(
+    s_both = slope_per_step(
         chain(bwd_step), q, k, v, n_small=n_small, n_large=n_large,
-        iters=5, warmup=1, stat="min",
+        iters=5, warmup=1, stat="min", repeats=2,
     )
+    per_fwd, per_both = s_fwd.per_step, s_both.per_step
     bq = default_block_q(T, T)
     bk = default_block_size("pallas", T)
     pass_flops = 2 * bq * bk * D * B * H * _live_tiles(T, T, bq, bk)
@@ -288,11 +317,13 @@ def _train_record(T=4096, n_small=16, n_large=64):
             "us_per_step": round(per_fwd * 1e6, 1),
             "tflops_per_sec": round(fwd_flops / per_fwd / 1e12, 1),
             "mfu_pct": round(fwd_flops / per_fwd / BF16_PEAK * 100, 1),
+            "slope_spread_pct": round(s_fwd.spread_pct, 1),
         },
         "fwd_bwd": {
             "us_per_step": round(per_both * 1e6, 1),
             "tflops_per_sec": round(both_flops / per_both / 1e12, 1),
             "mfu_pct": round(both_flops / per_both / BF16_PEAK * 100, 1),
+            "slope_spread_pct": round(s_both.spread_pct, 1),
         },
     }
 
@@ -378,6 +409,101 @@ def _tree_vs_ring_record():
     except Exception as e:
         rec["gqa_8k"] = {"error": f"{type(e).__name__}: {e}"}
     return rec
+
+
+def _attach_measurement_artifacts(suite):
+    """Attach this round's once-per-round measured artifacts to the suite.
+
+    The N-scaling sweep (hours of serialized 1-core compute,
+    ``tools/scaling_sweep.py``) and the stock-kernel race (chip time,
+    ``tools/race_stock_flash.py``) are too expensive to regenerate on
+    every bench invocation; their tools write JSON artifacts under the
+    round's ``measurements/r{N}/`` and this attaches the NEWEST round's
+    copy of each (so a later round that has not re-run a sweep still
+    surfaces the newest one that exists), with its embedded commit +
+    capture-time provenance and source path — a stale artifact is
+    auditable rather than invisible."""
+    import glob as _glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name, fname, tool in (
+        ("tree_vs_ring_decode_scaling", "decode_scaling.json",
+         "scaling_sweep"),
+        ("stock_flash_race", "stock_flash_race.json", "race_stock_flash"),
+    ):
+        paths = sorted(
+            _glob.glob(os.path.join(here, "measurements", "r*", fname)),
+            # r10 must sort after r9: numeric round key, not lexical.
+            key=lambda p: (len(os.path.basename(os.path.dirname(p))),
+                           os.path.basename(os.path.dirname(p))),
+        )
+        if not paths:
+            suite[name] = {
+                "skipped": f"no measurements/r*/{fname} artifact "
+                           f"(run tools/{tool}.py)"
+            }
+            continue
+        path = paths[-1]
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                raise ValueError(f"expected a JSON object, got {type(data).__name__}")
+            data["artifact_path"] = os.path.relpath(path, here)
+            suite[name] = data
+        except (OSError, ValueError) as e:
+            suite[name] = {"error": f"unreadable artifact {path}: {e}"}
+
+
+def _ici_crossover_record(suite):
+    """Re-price the north-star tree÷ring crossover from THIS run's
+    measurements (VERDICT r4 item 4: the falsifiable chain must rebuild its
+    measured terms every run, not quote a frozen literal).
+
+    - ``roofline_frac``: median over this run's non-suspect decode records
+      (replayed evidence counts — it carries the same fields).
+    - merge payloads: the compiled-HLO comm accounting from this run's
+      decode comparator for the MHA reference shape; the GQA table prices
+      its 2× larger 32-query-head merge from the closed form, because the
+      measured payload is a 16-head quantity (ADVICE r4 item 3).
+    """
+    from tree_attention_tpu.bench.ici import (
+        crossover_table,
+        decode_record_pcts,
+        measured_roofline_frac,
+        payloads_from_comm_record,
+    )
+
+    # One shared exclusion rule (ici.decode_record_pcts): chip decode
+    # records only — no "_cpu" fallback workloads, nothing flagged
+    # timing_suspect.
+    pcts = decode_record_pcts(suite, key="pct_hbm_roofline")
+    frac = measured_roofline_frac(pcts)
+    payloads = None
+    for sub in (suite.get("tree_vs_ring_decode_cpu8") or {}).values():
+        if isinstance(sub, dict):
+            payloads = payloads_from_comm_record(sub)
+            if payloads:
+                break
+    mha_kw = {}
+    if payloads:
+        mha_kw = dict(tree_payload=payloads["tree"],
+                      ring_hop_payload=payloads["ring_hop"])
+    return {
+        "roofline_frac": round(frac, 4),
+        "roofline_frac_source": (
+            f"median of {len(pcts)} decode records this run" if pcts
+            else "fallback constant (no decode records this run)"
+        ),
+        "payload_source": (
+            "compiled-HLO comm accounting this run (MHA table)"
+            if payloads else "closed form"
+        ),
+        "mha_1m": crossover_table(1 << 20, roofline_frac=frac, **mha_kw),
+        "gqa4_1m": crossover_table(
+            1 << 20, roofline_frac=frac, q_heads=32, kv_heads=4,
+        ),
+    }
 
 
 def _git_commit():
@@ -474,7 +600,8 @@ _TPU_RECORDS = ("decode_64k", "decode_gqa_128k", "decode_gqa_1m",
                 "decode_mha_1m", "decode_64k_q8", "decode_64k_q8q",
                 "decode_gqa_256k_q8q",
                 "train_fwd_bwd", "train_fwd_bwd_16k",
-                "train_fwd_bwd_32k", "train_fwd_bwd_64k")
+                "train_fwd_bwd_32k", "train_fwd_bwd_64k",
+                "train_fwd_bwd_128k")
 
 
 def _save_evidence(suite) -> None:
@@ -493,7 +620,11 @@ def _save_evidence(suite) -> None:
         with open(_EVIDENCE_PATH, "a") as f:
             for name in _TPU_RECORDS:
                 rec = suite.get(name)
-                if rec and "error" not in rec and "skipped" not in rec:
+                # Suspect records (fence failure / jitter) must not be
+                # persisted: replay would resurrect a number the record
+                # itself says to discard.
+                if (rec and "error" not in rec and "skipped" not in rec
+                        and "timing_suspect" not in rec):
                     f.write(json.dumps(
                         {"record": name, "captured_at": stamp,
                          "commit": commit, **rec}
@@ -572,28 +703,38 @@ def main() -> None:
             else:
                 suite[name] = {"skipped": "tpu unreachable; cpu fallback"}
     else:
-        run("decode_64k", _decode_record, 16, 16, 64000, 32, 128)
-        run("decode_gqa_128k", _decode_record, 32, 4, 131072, 16, 64)
-        run("decode_gqa_1m", _decode_record, 32, 4, 1 << 20, 4, 16)
-        run("decode_mha_1m", _decode_record, 16, 16, 1 << 20, 2, 8)
-        run("decode_64k_q8", _decode_q8_record, 16, 16, 64000, 32, 128)
-        run("decode_64k_q8q", _decode_q8_record, 16, 16, 64000, 32, 128,
+        # Chain lengths are sized so the marginal work (n_large - n_small)
+        # x per-step clears ~100 ms — the tunnel protocol's floor for a
+        # slope that dwarfs residual per-call jitter (the r4 driver capture
+        # read decode_64k at 58% of roofline off a 68 ms marginal; every
+        # other record, all >=100 ms, landed at 83-93%).
+        run("decode_64k", _decode_record, 16, 16, 64000, 32, 256)
+        run("decode_gqa_128k", _decode_record, 32, 4, 131072, 32, 320)
+        run("decode_gqa_1m", _decode_record, 32, 4, 1 << 20, 4, 40)
+        run("decode_mha_1m", _decode_record, 16, 16, 1 << 20, 2, 12)
+        run("decode_64k_q8", _decode_q8_record, 16, 16, 64000, 32, 320)
+        run("decode_64k_q8q", _decode_q8_record, 16, 16, 64000, 32, 320,
             q_quant=True)
         # BASELINE config 4's class (GQA decode against a long cache) over
         # the quantized path: 32q/4kv at 256k ctx, int8-MXU kernel through
         # the product dispatcher.
         run("decode_gqa_256k_q8q", _decode_q8_record, 32, 4, 1 << 18, 32,
-            128, q_quant=True)
-        run("train_fwd_bwd", _train_record)
+            320, q_quant=True)
+        run("train_fwd_bwd", _train_record, 4096, 16, 256)
         # BASELINE config 2's shape (seq 16384): MFU progress toward the
         # north star is tracked round over round at this length too.
-        run("train_fwd_bwd_16k", _train_record, 16384, 2, 8)
+        run("train_fwd_bwd_16k", _train_record, 16384, 2, 16)
         # The longest single-chip-feasible causal training shapes (VERDICT
-        # r3 item 5): 32k and 64k anchor the config-5 scaling trend this
-        # hardware can produce. Short chains — the steps are 4x/16x the
-        # 16k step's work, so the slope base is already >100 ms.
+        # r3 item 5): 32k, 64k and 128k anchor the config-5 scaling trend
+        # this hardware can produce. Short chains — the steps are
+        # 4x/16x/64x the 16k step's work, so the slope base is already
+        # >100 ms.
         run("train_fwd_bwd_32k", _train_record, 32768, 2, 6)
         run("train_fwd_bwd_64k", _train_record, 65536, 1, 3)
+        # VERDICT r4 item 5: one more doubling of the ladder. The chunked
+        # Q gather bounds the transient; Q/K/V + grads at 128k are ~3.2 GB
+        # of the 16 GB HBM, and flash recompute keeps activations O(T).
+        run("train_fwd_bwd_128k", _train_record, 131072, 1, 3)
         # Allocator peak has no reset API, so a per-workload peak is not
         # observable in one process — record the process-lifetime peak once
         # (set by the largest workload, the 1M-context decode). Per-workload
@@ -607,6 +748,8 @@ def main() -> None:
         _save_evidence(suite)
     run("tree_vs_ring_cpu8", _tree_vs_ring_record)
     run("tree_vs_ring_decode_cpu8", _tree_vs_ring_decode_record)
+    run("ici_crossover", _ici_crossover_record, suite)
+    _attach_measurement_artifacts(suite)
 
     # The headline metric name carries the backend so a headline-only
     # consumer (the round-over-round BENCH_r{N} comparison) can never
@@ -622,6 +765,10 @@ def main() -> None:
     else:
         head = suite.get("decode_64k_cpu", {})
         metric += "_CPUFALLBACK"
+    if isinstance(head, dict) and "timing_suspect" in head:
+        # The record says its own number is untrustworthy; a headline
+        # consumer must see that without opening the suite.
+        metric += "_SUSPECT"
     tokens_per_sec = head.get("kv_tokens_per_sec", 0.0)
     record = {
         "metric": metric,
@@ -653,6 +800,12 @@ def _summarize_record(name, rec):
     out = {}
     if "pct_hbm_roofline" in rec:
         out["pct_roofline"] = rec["pct_hbm_roofline"]
+        # The record's own error bar (VERDICT r4 item 1): the summary a
+        # driver keeps must say how trustworthy its headline figure is.
+        if "slope_spread_pct" in rec:
+            out["spread_pct"] = rec["slope_spread_pct"]
+        if "timing_suspect" in rec:
+            out["timing_suspect"] = True
     for pass_name in ("fwd", "fwd_bwd"):
         if pass_name in rec and "mfu_pct" in rec[pass_name]:
             out[f"{pass_name}_mfu_pct"] = rec[pass_name]["mfu_pct"]
@@ -670,6 +823,29 @@ def _summarize_record(name, rec):
         for ctx, sub in rec.items():
             if isinstance(sub, dict) and "tree_speedup_vs_ring" in sub:
                 out[f"{ctx}_vs_ring"] = sub["tree_speedup_vs_ring"]
+    if name == "ici_crossover":
+        out["roofline_frac"] = rec.get("roofline_frac")
+        for table in ("mha_1m", "gqa4_1m"):
+            if table in rec:
+                out[f"{table}_first_2x"] = rec[table].get("first_n_with_2x")
+    if name == "tree_vs_ring_decode_scaling" and isinstance(
+        rec.get("cells"), dict
+    ):
+        # The small-ctx trend is the one emulation can show; the ring hop
+        # count at the largest N is the structural measurement.
+        for key, cell in sorted(rec["cells"].items()):
+            if not key.startswith("ctx2048"):
+                continue  # the small-ctx trend; 64k is compute-dominated
+            if "tree_speedup_vs_ring" in cell:
+                out[f"{key}_vs_ring"] = cell["tree_speedup_vs_ring"]
+            if isinstance(cell.get("ring"), dict):
+                out[f"{key}_ring_collectives"] = (
+                    cell["ring"]["collective_count"]
+                )
+    if name == "stock_flash_race" and isinstance(rec.get("cells"), dict):
+        for key, cell in sorted(rec["cells"].items()):
+            if "ours_vs_stock" in cell:
+                out[f"{key}_ours_vs_stock"] = cell["ours_vs_stock"]
     if rec.get("measured_earlier_this_round"):
         out["replayed"] = True
     if not out and any(

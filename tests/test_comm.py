@@ -134,10 +134,19 @@ def test_ici_model_table_is_monotone_and_crosses():
 
 def test_shape_bytes_async_start_takes_result_not_sum():
     # Async '-start' tuples alias the operand beside the result; the
-    # payload is the largest element, while sync fused tuples sum.
+    # payload is the RESULT half (positional), while sync fused tuples sum.
     assert _shape_bytes("(f32[8,128], f32[32,128])", is_start=True) == 32 * 128 * 4
     assert _shape_bytes("(f32[8,128], f32[32,128])") == (8 + 32) * 128 * 4
     assert _shape_bytes("(f32[16], f32[16], u32[], u32[])", is_start=True) == 64
+    # reduce-scatter-start: the operand is the N×-larger tensor; max()
+    # would pick it and overstate the transfer (ADVICE r4 item 1).
+    assert _shape_bytes(
+        "(f32[32,128], f32[8,128], u32[], u32[])", is_start=True
+    ) == 8 * 128 * 4
+    # Fused two-operand async form: first half operands, second results.
+    assert _shape_bytes(
+        "(f32[32,128], f32[32], f32[8,128], f32[8], u32[])", is_start=True
+    ) == 8 * 128 * 4 + 8 * 4
 
 
 def test_bench_summary_line_is_compact_and_parseable():
@@ -183,3 +192,42 @@ def test_bench_summary_line_is_compact_and_parseable():
     assert parsed["records"]["decode_gqa_1m"] == "skipped"
     assert parsed["records"]["train_fwd_bwd"] == "error"
     assert {"metric", "value", "unit", "vs_baseline", "commit"} <= set(parsed)
+
+
+def test_ici_measured_terms_rebuild_from_records():
+    """VERDICT r4 item 4 / ADVICE item 3: the model's measured terms come
+    from records (median, suspect-robust) and the payloads scale with
+    QUERY heads, priced inside step_times."""
+    from tree_attention_tpu.bench import ici
+
+    # Median is robust to one noisy capture (the r4 58.1% outlier class).
+    assert ici.measured_roofline_frac([58.1, 89.1, 91.7, 92.6]) == (
+        (89.1 + 91.7) / 2 / 100
+    )
+    assert ici.measured_roofline_frac([]) == ici.DEFAULT_ROOFLINE_FRAC
+
+    # Closed-form payloads at the reference shape match the compiled-HLO
+    # measurement in the r4 comparator record (8320 / 8256 bytes).
+    tree_p, ring_hop = ici.merge_payloads(16)
+    assert tree_p == 8320 and ring_hop == 8256
+    # Payloads scale with QUERY heads, not KV heads (ADVICE r4 item 3).
+    tree_gqa, ring_gqa = ici.merge_payloads(32)
+    assert tree_gqa == 2 * tree_p and ring_gqa == 2 * ring_hop
+
+    rec = {
+        "n_devices": 8,
+        "tree": {"comm": {"payload_bytes_total": 8320}},
+        "ring": {"comm": {"payload_bytes_total": 57792}},
+    }
+    p = ici.payloads_from_comm_record(rec)
+    assert p == {"tree": 8320, "ring_hop": 8256}
+    assert ici.payloads_from_comm_record({"n_devices": 8}) is None
+
+    # A 32q/4kv GQA config priced at q_heads=32 must cross earlier than
+    # MHA at the same context (bigger merge, smaller compute)...
+    g = ici.step_times(64, 1 << 20, kv_heads=4, q_heads=32)
+    assert g["ring"] / g["tree"] >= 2.0
+    # ...and pricing it with the 16-head payload (the old bug) understates
+    # the tree's own merge cost: the q_heads=32 tree step must be slower.
+    g16 = ici.step_times(64, 1 << 20, kv_heads=4, q_heads=16)
+    assert g["tree"] > g16["tree"]

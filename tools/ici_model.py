@@ -1,76 +1,43 @@
-"""Price the decode-merge communication on real ICI: the north-star model.
+"""CLI for the north-star ICI pricing model (VERDICT r4 item 4).
 
-The ≥2×-vs-ring north star (BASELINE.json: tree ≥2× ring tokens/sec/chip at
-1M context) cannot be *measured* on this hardware (one chip; the emulated
-mesh prices collectives at memcpy). This tool makes it *falsifiable*
-instead (VERDICT r3 item 1): every term is either measured in this repo or
-a published hardware constant, so anyone with a pod can check the
-prediction — and any term they refute, refutes the claim.
+The model itself lives in :mod:`tree_attention_tpu.bench.ici` so bench.py
+re-prices the tree÷ring crossover from live measurements every run. This
+shim prints the table BASELINE.md quotes, pulling the MEASURED terms from
+the repo's records rather than frozen literals:
 
-Terms:
+- ``roofline_frac`` — median over the decode records of the newest
+  ``BENCH_r*.json`` (``--roofline-frac`` overrides; the documented
+  fallback constant only applies on a checkout with no captures).
+- merge payloads — closed form at ``--q-heads`` (they scale with QUERY
+  heads — ADVICE r4 item 3), cross-checkable against the compiled-HLO
+  accounting in any ``tree_vs_ring_decode_cpu8`` record.
 
-- **Per-chip compute** t_comp = KV_shard_bytes / (roofline_frac · HBM_BW).
-  Decode is HBM-bound; ``roofline_frac`` is MEASURED on the v5e chip
-  (BENCH_r03: 0.88–0.91 across 64k–1M contexts — the kernel streams the
-  shard at ~0.9 of spec bandwidth).
-- **Merge payloads** — MEASURED from each algorithm's compiled SPMD module
-  (``bench.py`` record ``tree_vs_ring_decode_cpu8``, parsed by
-  ``tree_attention_tpu/bench/comm.py``): tree = one pmax (B·H·Tq·4 B) +
-  one psum (B·H·Tq·(D+1)·4 B) = 8 320 B at the reference shape; ring =
-  N−1 sequential hops of 8 256 B; Ulysses = all-to-all of the whole KV
-  shard (context-proportional).
-- **ICI constants** — published v5e figures (assumptions, stated so they
-  can be attacked): per-hop latency ALPHA ≈ 1 µs, per-link one-way
-  bandwidth BETA ≈ 45 GB/s (2D torus). The model is parametric; pass
-  ``--alpha/--beta`` to re-price.
-
-Cost model (latency-dominated regime — the payloads are KB-scale):
-
-    t_tree  = t_comp + ceil(log2 N) · (2·ALPHA + tree_payload/BETA)
-    t_ring  = t_comp + (N−1) · (ALPHA + hop_payload/BETA)
-    t_uly   = t_comp + (N−1)·ALPHA + kv_shard_bytes·(N−1)/N / BETA
-
-(tree: the pmax and psum each run a log-depth stage chain; ring: the hop
-chain is sequential by construction; Ulysses: bandwidth-dominated by the
-KV reshard.) Run ``python tools/ici_model.py`` to print the table that
-BASELINE.md's north-star section quotes.
+Run:  python tools/ici_model.py [--ctx N] [--q-heads N] [--kv-heads N]
+      [--alpha S] [--beta B/s] [--json]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import math
+import os
+import sys
 
-# Published / measured constants (see module docstring).
-HBM_BW = 819e9          # v5e spec HBM bandwidth, B/s
-ROOFLINE_FRAC = 0.88    # measured: BENCH_r03 decode records, 88-91%
-ALPHA = 1e-6            # ICI per-hop latency, s (published figure ~1 us)
-BETA = 4.5e10           # ICI per-link one-way bandwidth, B/s (v5e)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Reference decode shape (model.py:140-145) with a bf16 cache.
-B, H, TQ, D = 1, 16, 1, 128
-CACHE_BYTES = 2  # bf16
+from tree_attention_tpu.bench.ici import (  # noqa: E402
+    ALPHA,
+    BETA,
+    DEFAULT_ROOFLINE_FRAC,
+    REF_HEADS,
+    crossover_table,
+    load_bench_roofline_fracs,
+    measured_roofline_frac,
+    merge_payloads,
+    step_times,  # re-exported for callers/tests of the old module path
+)
 
-# Merge payloads, corroborated by the compiled-HLO measurement in the
-# tree_vs_ring_decode_cpu8 record (f32 merge state). Note both scale with
-# the QUERY head count only — a GQA cache shrinks t_comp 4×–8× while the
-# merge payload is unchanged, which pulls the tree-vs-ring crossover to
-# smaller N (the merge's relative weight grows).
-TREE_PAYLOAD = B * H * TQ * 4 + B * H * TQ * (D + 1) * 4   # pmax + psum
-RING_HOP_PAYLOAD = B * H * TQ * (D + 1) * 4                # (out, lse) hop
-
-
-def step_times(n: int, ctx: int, *, alpha: float = ALPHA, beta: float = BETA,
-               kv_heads: int = H):
-    """Predicted per-decode-step seconds for each family at N chips."""
-    kv_shard = 2 * (ctx // n) * kv_heads * D * CACHE_BYTES
-    t_comp = kv_shard / (ROOFLINE_FRAC * HBM_BW)
-    stages = math.ceil(math.log2(n))
-    t_tree = t_comp + stages * (2 * alpha + TREE_PAYLOAD / beta)
-    t_ring = t_comp + (n - 1) * (alpha + RING_HOP_PAYLOAD / beta)
-    t_uly = t_comp + (n - 1) * alpha + kv_shard * (n - 1) / n / beta
-    return {"comp": t_comp, "tree": t_tree, "ring": t_ring, "ulysses": t_uly}
+__all__ = ["step_times", "merge_payloads", "crossover_table", "main"]
 
 
 def main() -> None:
@@ -78,53 +45,53 @@ def main() -> None:
     p.add_argument("--ctx", type=int, default=1 << 20)
     p.add_argument("--alpha", type=float, default=ALPHA)
     p.add_argument("--beta", type=float, default=BETA)
-    p.add_argument("--kv-heads", type=int, default=H,
+    p.add_argument("--q-heads", type=int, default=REF_HEADS,
+                   help="QUERY head count — the merge payloads scale with "
+                        "it (a 32q GQA config prices a 2x larger merge "
+                        "than the 16-head reference)")
+    p.add_argument("--kv-heads", type=int, default=REF_HEADS,
                    help="KV head count (GQA shrinks per-chip compute but "
                         "not the merge payload: earlier crossover)")
+    p.add_argument("--roofline-frac", type=float, default=None,
+                   help="override the measured HBM roofline fraction "
+                        "(default: median of the newest BENCH_r*.json "
+                        "decode records)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args()
 
-    rows = []
-    crossover = None
-    for n in (8, 16, 32, 64, 128, 256, 512):
-        t = step_times(n, args.ctx, alpha=args.alpha, beta=args.beta,
-                       kv_heads=args.kv_heads)
-        ratio = t["ring"] / t["tree"]
-        rows.append({
-            "chips": n,
-            "t_comp_us": round(t["comp"] * 1e6, 1),
-            "t_tree_us": round(t["tree"] * 1e6, 1),
-            "t_ring_us": round(t["ring"] * 1e6, 1),
-            "t_ulysses_us": round(t["ulysses"] * 1e6, 1),
-            "tree_vs_ring": round(ratio, 2),
-        })
-        if crossover is None and ratio >= 2.0:
-            crossover = n
-    out = {
-        "ctx": args.ctx,
-        "assumptions": {
-            "alpha_s": args.alpha, "beta_Bps": args.beta,
-            "hbm_Bps": HBM_BW, "roofline_frac": ROOFLINE_FRAC,
-            "tree_payload_B": TREE_PAYLOAD,
-            "ring_hop_payload_B": RING_HOP_PAYLOAD,
-        },
-        "rows": rows,
-        "first_n_with_2x": crossover,
-    }
+    if args.roofline_frac is not None:
+        frac, source = args.roofline_frac, "--roofline-frac"
+    else:
+        pcts, path = load_bench_roofline_fracs()
+        frac = measured_roofline_frac(pcts)
+        source = (
+            f"median of {len(pcts)} decode records in "
+            f"{os.path.basename(path)}" if path
+            else f"fallback constant {DEFAULT_ROOFLINE_FRAC} (no BENCH_r*.json)"
+        )
+
+    out = crossover_table(
+        args.ctx, alpha=args.alpha, beta=args.beta, roofline_frac=frac,
+        q_heads=args.q_heads, kv_heads=args.kv_heads,
+    )
+    out["roofline_frac_source"] = source
     if args.json:
         print(json.dumps(out))
         return
-    print(f"# ctx={args.ctx}  alpha={args.alpha * 1e6:.1f}us  "
-          f"beta={args.beta / 1e9:.0f}GB/s  "
-          f"tree_payload={TREE_PAYLOAD}B  ring_hop={RING_HOP_PAYLOAD}B")
+    a = out["assumptions"]
+    print(f"# ctx={out['ctx']}  alpha={a['alpha_s'] * 1e6:.1f}us  "
+          f"beta={a['beta_Bps'] / 1e9:.0f}GB/s  "
+          f"roofline_frac={a['roofline_frac']} ({source})  "
+          f"tree_payload={a['tree_payload_B']}B  "
+          f"ring_hop={a['ring_hop_payload_B']}B")
     print("| chips | t_comp (µs) | tree (µs) | ring (µs) | ulysses (µs) "
           "| tree÷ring |")
     print("|---|---|---|---|---|---|")
-    for r in rows:
+    for r in out["rows"]:
         print(f"| {r['chips']} | {r['t_comp_us']} | {r['t_tree_us']} "
               f"| {r['t_ring_us']} | {r['t_ulysses_us']} "
               f"| {r['tree_vs_ring']}× |")
-    print(f"first N with >=2x: {crossover}")
+    print(f"first N with >=2x: {out['first_n_with_2x']}")
 
 
 if __name__ == "__main__":

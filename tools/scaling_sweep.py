@@ -1,0 +1,144 @@
+"""Decode-comparator N-scaling on the emulated mesh (VERDICT r4 item 2).
+
+Runs tree / ring / Ulysses decode at N = 2, 4, 8, 16, 32 virtual CPU
+devices on the reference decode shape (q_len=1, 16 heads × 128 D) at two
+contexts, recording per-step wall clock AND HLO-parsed collective counts
+per N. The claim under test is *structural*: ring's merge is a sequential
+chain of 2(N−1) collective-permutes while tree's is 2 fused all-reduces
+regardless of N — so as N grows, ring's wall clock must diverge from
+tree's even at the emulated mesh's memcpy-level collective pricing, and
+the collective counts parsed from the compiled SPMD modules must grow
+exactly as 2(N−1) vs stay at 2.
+
+What this sweep can and cannot prove (the annotation VERDICT r4 weak
+item 2 asked for, recorded into the artifact): the emulated mesh
+timeshares every "device" on one host core and prices collectives at
+memcpy cost, so the absolute tree÷ring ratio at any single N here does
+NOT transfer to ICI — at ctx 64000 the serialized local compute dominates
+and the ratio reads ~1.0 (an N=8 reading of 0.89 in r4 is the same
+noise-about-parity). What DOES transfer is the *trend*: hop counts
+growing linearly in N (measured from HLO) with wall clock following at
+small ctx, which is the structure the ICI model
+(``tree_attention_tpu/bench/ici.py``) prices with real latency/bandwidth
+constants to get the ≥2× crossover at N≳128 (MHA 1M) / N≳64 (GQA-4).
+
+Each (ctx, N) cell runs in its own CPU subprocess through the product CLI
+(``--comparator ring-decode``), same as bench.py's comparator record.
+Writes ``measurements/r5/decode_scaling.json``; bench.py attaches it as
+the ``tree_vs_ring_decode_scaling`` record.
+
+Run (hours of 1-core time; never concurrently with chip measurements):
+    python tools/scaling_sweep.py [--ns 2 4 8 16 32] [--ctxs 64000 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cell(n: int, ctx: int, iters: int, timeout: int):
+    """One (N devices, context) comparator record via the product CLI."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", ""
+        ).strip()
+        + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tree_attention_tpu", "--mode", "bench",
+         "--device", "cpu", "--n-virtual-cpu", str(n),
+         "--mesh", f"seq={n}", "--causal",
+         "--comparator", "ring-decode", "--seq-len", str(ctx),
+         "--q-len", "1", "--heads", "16", "--head-dim", "128",
+         "--iters", str(iters), "--dtype", "float32"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=timeout,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"N={n} ctx={ctx} rc={proc.returncode}: {proc.stderr[-400:]}"
+        )
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"N={n} ctx={ctx}: no JSON in CLI output")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ns", type=int, nargs="+", default=[2, 4, 8, 16, 32])
+    p.add_argument("--ctxs", type=int, nargs="+", default=[64000, 2048])
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--timeout", type=int, default=3600)
+    p.add_argument("--out", default=os.path.join(
+        REPO, "measurements", "r5", "decode_scaling.json"
+    ))
+    args = p.parse_args()
+
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+        text=True, cwd=REPO,
+    ).stdout.strip()
+    result = {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "commit": commit,
+        "workload": "reference decode shape: q_len=1, 16 heads, head_dim "
+                    "128 (model.py:140-145), f32 on the emulated mesh",
+        "interpretation": (
+            "Emulated mesh: devices timeshare one core, collectives are "
+            "memcpys — absolute tree/ring ratios do NOT transfer to ICI; "
+            "the transferable measurements are the HLO collective counts "
+            "(ring 2(N-1) sequential permutes vs tree 2 fused all-reduces) "
+            "and the small-ctx wall-clock trend that follows them. The ICI "
+            "model prices those counts with real latency/bandwidth for the "
+            "north-star crossover (BASELINE.md)."
+        ),
+        "cells": {},
+    }
+    # Partial results are written after every cell: each is minutes of
+    # 1-core compute and a late failure must not erase the sweep.
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    for ctx in args.ctxs:
+        for n in args.ns:
+            key = f"ctx{ctx}_n{n}"
+            t0 = time.time()
+            try:
+                rec = run_cell(n, ctx, args.iters, args.timeout)
+                cell = {"n_devices": n, "ctx": ctx}
+                for alg in ("tree", "ring", "ulysses"):
+                    sub = rec.get(alg)
+                    if isinstance(sub, dict):
+                        cell[alg] = {
+                            "us_per_step": sub["us_per_step"],
+                            "collective_count":
+                                sub["comm"]["collective_count"],
+                            "payload_bytes_total":
+                                sub["comm"]["payload_bytes_total"],
+                        }
+                for k in ("tree_speedup_vs_ring", "tree_speedup_vs_ulysses"):
+                    if k in rec:
+                        cell[k] = rec[k]
+                result["cells"][key] = cell
+            except Exception as e:
+                result["cells"][key] = {
+                    "error": f"{type(e).__name__}: {e}"[:400]
+                }
+            result["cells"][key]["wall_s"] = round(time.time() - t0, 1)
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+            print(json.dumps({key: result["cells"][key]}), flush=True)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

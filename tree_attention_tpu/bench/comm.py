@@ -17,7 +17,7 @@ tree merge's two psum operands riding one fused all-reduce.
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 
@@ -51,9 +51,11 @@ _DTYPE_BYTES = {
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
-def _element_bytes(shape_str: str) -> List[int]:
-    """Bytes of each typed array in an HLO result type string
-    (tuples like `(f32[8], f32[8,128])` yield one entry per element)."""
+def _element_bytes(shape_str: str) -> List[Tuple[int, bool]]:
+    """(bytes, has_dims) of each typed array in an HLO result type string
+    (tuples like `(f32[8], f32[8,128])` yield one entry per element);
+    ``has_dims`` distinguishes real arrays from dimensionless context
+    scalars like the `u32[]` pair async-start ops append."""
     out = []
     for dtype, dims in _SHAPE_RE.findall(shape_str):
         if dtype not in _DTYPE_BYTES:
@@ -62,7 +64,7 @@ def _element_bytes(shape_str: str) -> List[int]:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        out.append(n * _DTYPE_BYTES[dtype])
+        out.append((n * _DTYPE_BYTES[dtype], bool(dims)))
     return out
 
 
@@ -71,16 +73,24 @@ def _shape_bytes(shape_str: str, *, is_start: bool = False) -> int:
 
     Sync form: a tuple result is a *fused* collective (e.g. the tree
     merge's two psum operands riding one all-reduce) — the payload is the
-    sum. Async ``-start`` form: the tuple aliases the operand alongside
-    the result (plus u32 context scalars), so summing would double-count;
-    the transfer payload is the largest element (equals the sync form's
-    result for every collective opcode)."""
+    sum. Async ``-start`` form: the tuple is
+    ``((operands…), (results…), u32[] context…)`` — summing would
+    double-count, and taking the max would overstate reduce-scatter, whose
+    operand is the N×-larger tensor sitting beside the shard-sized result
+    (ADVICE r4 item 1). So: drop the dimensionless context scalars and sum
+    the second half of what remains — the results — which equals the sync
+    form's payload for every collective opcode. An unexpected layout (odd
+    element count) falls back to the max, which is exact for every opcode
+    except reduce-scatter."""
     elems = _element_bytes(shape_str)
     if not elems:
         return 0
     if is_start and len(elems) > 1:
-        return max(elems)
-    return sum(elems)
+        arrays = [b for b, has_dims in elems if has_dims]
+        if arrays and len(arrays) % 2 == 0:
+            return sum(arrays[len(arrays) // 2:])
+        return max(b for b, _ in elems)
+    return sum(b for b, _ in elems)
 
 
 # `%name = <result-type> <opcode>(`  — opcode may carry a -start suffix

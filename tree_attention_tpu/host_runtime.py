@@ -1,4 +1,4 @@
-"""ctypes bindings for the native host runtime (``native/treeattn_host.cc``).
+"""ctypes bindings for the native host runtime (``tree_attention_tpu/native/treeattn_host.cc``).
 
 The reference gets its host-side native capability for free from libtorch:
 ATen's Philox RNG (``/root/reference/model.py:50``) and multiprocessing's
@@ -33,10 +33,39 @@ from tree_attention_tpu.utils.logging import get_logger
 
 log = get_logger("host_runtime")
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libtreeattn_host.so")
+# The native sources ship inside the package (``tree_attention_tpu/native``
+# is package data, pyproject ``[tool.setuptools.package-data]``) so an
+# installed wheel can build the runtime on first use, same as a source
+# checkout. When the install location is read-only (system site-packages),
+# the build lands in ``~/.cache/tree-attention-tpu`` instead.
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
 _SRC_PATH = os.path.join(_NATIVE_DIR, "treeattn_host.cc")
+
+
+def _build_dir() -> str:
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return os.path.join(_NATIVE_DIR, "build")
+    # Read-only install: build into the user cache, keyed by the SOURCE
+    # content hash — two venvs with different package versions must not
+    # share one .so (the mtime staleness check cannot catch a newer .so
+    # built from a different install's source, and ctypes would bind old
+    # prototypes to a mismatched library).
+    import hashlib
+
+    try:
+        with open(_SRC_PATH, "rb") as f:
+            key = hashlib.sha256(f.read()).hexdigest()[:12]
+    except OSError:
+        key = "unknown"
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "tree-attention-tpu", key
+    )
+
+
+def _so_path() -> str:
+    return os.path.join(_build_dir(), "libtreeattn_host.so")
+
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -46,7 +75,7 @@ _lib_tried = False
 def _compile() -> bool:
     try:
         proc = subprocess.run(
-            ["make", "-C", _NATIVE_DIR],
+            ["make", "-C", _NATIVE_DIR, "BUILD=" + _build_dir()],
             capture_output=True, text=True, timeout=120,
         )
         if proc.returncode != 0:
@@ -65,14 +94,15 @@ def load_native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        stale = not os.path.exists(_SO_PATH) or (
+        so = _so_path()
+        stale = not os.path.exists(so) or (
             os.path.exists(_SRC_PATH)
-            and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
+            and os.path.getmtime(_SRC_PATH) > os.path.getmtime(so)
         )
         if stale and not _compile():
             return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(so)
         except OSError as e:
             log.warning("native library load failed: %s", e)
             return None
@@ -152,7 +182,7 @@ def load_native() -> Optional[ctypes.CDLL]:
                 ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
             ]
         _lib = lib
-        log.info("native host runtime loaded: %s", _SO_PATH)
+        log.info("native host runtime loaded: %s", so)
         return _lib
 
 

