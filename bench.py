@@ -65,8 +65,9 @@ import os
 import subprocess
 import sys
 
-HBM_ROOFLINE = 819e9  # TPU v5e spec HBM bandwidth, bytes/s
-BF16_PEAK = 197e12  # TPU v5e spec bf16 peak, FLOP/s
+# Hardware spec constants: one definition package-wide (bench/ici.py).
+from tree_attention_tpu.bench.ici import BF16_PEAK, HBM_BW as HBM_ROOFLINE
+
 BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
 
 
@@ -309,7 +310,7 @@ def _train_record(T=4096, n_small=16, n_large=64):
     pass_flops = 2 * bq * bk * D * B * H * _live_tiles(T, T, bq, bk)
     fwd_flops = 2 * pass_flops
     both_flops = 9 * pass_flops  # fwd 2 + dQ 3 + dKV 4
-    return {
+    rec = {
         "workload": {"batch": B, "heads": H, "seq_len": T, "head_dim": D,
                      "causal": True, "dtype": "bfloat16",
                      "block_q": bq, "block_k": bk},
@@ -326,6 +327,15 @@ def _train_record(T=4096, n_small=16, n_large=64):
             "slope_spread_pct": round(s_both.spread_pct, 1),
         },
     }
+    # Same physical-plausibility fence as the decode records: >100% MFU is
+    # not a fast chip, it is a fence that did not fence. The flag keeps the
+    # record out of the evidence replay and the pricing model's inputs.
+    if any(rec[p]["mfu_pct"] > 100 for p in ("fwd", "fwd_bwd")):
+        rec["timing_suspect"] = (
+            "MFU above the bf16 peak — the fetch fence did not fence; "
+            "discard this record"
+        )
+    return rec
 
 
 def _comparator_subprocess(args, timeout=900):
@@ -809,6 +819,8 @@ def _summarize_record(name, rec):
     for pass_name in ("fwd", "fwd_bwd"):
         if pass_name in rec and "mfu_pct" in rec[pass_name]:
             out[f"{pass_name}_mfu_pct"] = rec[pass_name]["mfu_pct"]
+            if "timing_suspect" in rec:
+                out["timing_suspect"] = True
     for key in ("tree_speedup_vs_ring", "tree_zigzag_speedup_vs_ring",
                 "ratio_spread_pct"):
         if key in rec:

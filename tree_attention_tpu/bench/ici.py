@@ -52,9 +52,12 @@ import math
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-# Published hardware constants (see module docstring). These two are
-# *assumptions* — the model is parametric so a pod owner can re-price.
+# Published hardware constants (see module docstring) — the package's ONE
+# definition of each (bench.py, the harness fence guards, and the race
+# tool import from here). ALPHA/BETA are *assumptions* — the model is
+# parametric so a pod owner can re-price.
 HBM_BW = 819e9          # v5e spec HBM bandwidth, B/s
+BF16_PEAK = 197e12      # v5e spec bf16 matmul peak, FLOP/s
 ALPHA = 1e-6            # ICI per-hop latency, s (published figure ~1 us)
 BETA = 4.5e10           # ICI per-link one-way bandwidth, B/s (v5e)
 
@@ -161,7 +164,17 @@ def load_bench_roofline_fracs(
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)
         )))
-    paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json")))
+    def round_key(p: str) -> Tuple[int, str]:
+        # BENCH_r10 must sort after BENCH_r9 (and after BENCH_r04):
+        # numeric round key, not lexical.
+        stem = os.path.basename(p)[len("BENCH_r"):-len(".json")]
+        try:
+            return (int(stem), stem)
+        except ValueError:
+            return (-1, stem)
+
+    paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json")),
+                   key=round_key)
     for path in reversed(paths):
         try:
             with open(path) as f:
