@@ -43,6 +43,24 @@ from tree_attention_tpu.ops.block_utils import (
 )
 
 
+def _lane_bcast(x, n):
+    """Widen a lane-replicated ``(bq, LANES)`` state vector to ``(bq, n)``.
+
+    Every lane of ``x`` holds the same value, so slicing narrows and tiling
+    widens without changing semantics. The multiple-of-LANES paths stay
+    lane-aligned on the VPU; the ``n < LANES`` / non-multiple paths still
+    produce a sub-128-lane vector and pay its relayout (reachable only
+    with narrow heads or sub-128 test tiles, not the product shapes)."""
+    L = x.shape[-1]
+    if n == L:
+        return x
+    if n < L:
+        return x[:, :n]
+    if n % L == 0:
+        return jnp.tile(x, (1, n // L))
+    return jnp.tile(x, (1, -(-n // L)))[:, :n]
+
+
 def _flash_fwd_kernel(
     offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
     q_ref,     # VMEM (1, bq, D)
@@ -92,13 +110,21 @@ def _flash_fwd_kernel(
             s, qi, ki, block_q, block_k, q_offset, kv_offset, tk, causal
         )
 
-        m_prev = m_scr[:, :1]  # (bq, 1)
-        l_prev = l_scr[:, :1]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
+        # Softmax state math stays LANE-REPLICATED at (bq, LANES)
+        # throughout: narrow (bq, 1) intermediates force a VPU lane
+        # relayout per op, and with ~8 state ops per KV step that overhead
+        # measured ~19% of step time at 512/1024 tiles (44.0% -> 54.0%
+        # MFU, r5 race vs the JAX-bundled kernel, which keeps state at
+        # (bq, 128) for the same reason). Two narrow (bq, 1) reductions
+        # necessarily remain — the row max and the row sum of p — each
+        # broadcast back to lane width once.
+        m_prev = m_scr[...]  # (bq, LANES)
+        l_prev = l_scr[...]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_blk)          # (bq, LANES)
         m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
         alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
-        p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
+        p = jnp.exp(s - _lane_bcast(m_safe, s.shape[-1]))  # masked cols -> 0
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # P is cast to V's dtype for the second MXU matmul (the FA2 trick:
         # probabilities are in [0,1] so bf16 relative error stays small) and
@@ -113,28 +139,34 @@ def _flash_fwd_kernel(
                 + lax.broadcasted_iota(jnp.int32, v_tile.shape, 0)
             ) < tk
             v_tile = jnp.where(row_ok, v_tile, 0)
-        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+        acc_scr[...] = acc_scr[...] * _lane_bcast(
+            alpha, acc_scr.shape[-1]
+        ) + lax.dot_general(
             p.astype(v_ref.dtype), v_tile,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=matmul_precision(v_ref.dtype, v_ref.dtype),
         )
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        m = m_scr[:, :1]
-        l = l_scr[:, :1]
+        m = m_scr[...]  # (bq, LANES), lane-replicated
+        l = l_scr[...]
         empty = l <= 0.0
         l_safe = jnp.where(empty, 1.0, l)
+        D_acc = acc_scr.shape[-1]
         out_ref[0] = (
-            jnp.where(empty, 0.0, acc_scr[...] / l_safe)
+            jnp.where(
+                _lane_bcast(empty, D_acc), 0.0,
+                acc_scr[...] / _lane_bcast(l_safe, D_acc),
+            )
         ).astype(out_ref.dtype)
         lse = jnp.where(
             empty, NEG_INF, jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)
         )
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        lse_ref[0] = lse
 
 
 
