@@ -106,8 +106,30 @@ def main() -> None:
         "cells": {},
     }
     # Partial results are written after every cell: each is minutes of
-    # 1-core compute and a late failure must not erase the sweep.
+    # 1-core compute and a late failure must not erase the sweep. An
+    # existing artifact's cells are merged in, so re-running a subset
+    # (e.g. one noisy cell) refreshes those cells without erasing the
+    # rest of the sweep.
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    try:
+        with open(args.out) as f:
+            prior = json.load(f)
+        if isinstance(prior, dict) and isinstance(prior.get("cells"), dict):
+            result["cells"].update(prior["cells"])
+    except OSError:
+        pass  # no prior artifact: a fresh sweep
+    except ValueError:
+        print(f"WARNING: prior artifact {args.out} is unparseable; "
+              f"starting fresh (it will be overwritten)", flush=True)
+
+    def persist():
+        # Temp + atomic rename: a kill mid-write must not truncate the
+        # artifact (a truncated file would defeat the next run's merge).
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(tmp, args.out)
+
     for ctx in args.ctxs:
         for n in args.ns:
             key = f"ctx{ctx}_n{n}"
@@ -128,15 +150,25 @@ def main() -> None:
                 for k in ("tree_speedup_vs_ring", "tree_speedup_vs_ulysses"):
                     if k in rec:
                         cell[k] = rec[k]
-                result["cells"][key] = cell
             except Exception as e:
-                result["cells"][key] = {
-                    "error": f"{type(e).__name__}: {e}"[:400]
-                }
-            result["cells"][key]["wall_s"] = round(time.time() - t0, 1)
-            with open(args.out, "w") as f:
-                json.dump(result, f, indent=1)
-            print(json.dumps({key: result["cells"][key]}), flush=True)
+                err = f"{type(e).__name__}: {e}"[:400]
+                if key in result["cells"]:
+                    # A failed re-run must not erase a prior good cell:
+                    # keep it, note the failed refresh beside it.
+                    result["cells"][key]["refresh_error"] = err
+                    persist()
+                    print(json.dumps({key: {"refresh_error": err}}),
+                          flush=True)
+                    continue
+                cell = {"error": err}
+            # Per-cell provenance: merged prior cells keep their own
+            # stamps; this run's cells carry this run's commit/time.
+            cell["commit"] = commit
+            cell["captured_at"] = result["captured_at"]
+            cell["wall_s"] = round(time.time() - t0, 1)
+            result["cells"][key] = cell
+            persist()
+            print(json.dumps({key: cell}), flush=True)
     print(f"wrote {args.out}")
 
 
