@@ -231,3 +231,40 @@ def test_ici_measured_terms_rebuild_from_records():
     # the tree's own merge cost: the q_heads=32 tree step must be slower.
     g16 = ici.step_times(64, 1 << 20, kv_heads=4, q_heads=16)
     assert g["tree"] > g16["tree"]
+
+
+def test_slope_record_fields_guards():
+    """bench.py's shared decode-record tail: fast readings are suspect
+    (fence failure), wide spreads get the min-cycle note, clean records
+    get neither (VERDICT r4 item 1)."""
+    import importlib.util
+    import os as _os
+
+    path = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "bench.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_mod2", path)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    from tree_attention_tpu.utils.profiling import SlopeStats, TimingStats
+
+    ts = TimingStats(median=1, mean=1, minimum=1, maximum=1, iters=1,
+                     times=(1,))
+
+    def slope(per, spread, slopes):
+        return SlopeStats(per_step=per, slopes=slopes, spread_pct=spread,
+                          small=ts, large=ts)
+
+    kv = 512 * 1024 * 1024  # 512 MB stream
+    clean = kv / (0.9 * b.HBM_ROOFLINE)
+    per, f = b._slope_record_fields(slope(clean, 1.2, (clean,)), kv)
+    assert per == clean and "timing_suspect" not in f
+    assert "timing_note" not in f and f["slope_spread_pct"] == 1.2
+
+    fast = kv / (1.5 * b.HBM_ROOFLINE)  # 1.5x the spec: impossible
+    _, f = b._slope_record_fields(slope(fast, 0.5, (fast,)), kv)
+    assert "timing_suspect" in f
+
+    _, f = b._slope_record_fields(slope(clean, 38.4, (clean, clean * 1.4)), kv)
+    assert "timing_note" in f and "timing_suspect" not in f

@@ -166,6 +166,76 @@ class TestProfiling:
             time_per_step(lambda n: (lambda: None), n_small=2, n_large=4,
                           stat="p99")
 
+    def test_slope_per_step_repeats_takes_min_cycle_and_reports_spread(self):
+        # A contended first measurement window inflates BOTH sides' minima
+        # together, which a single cycle cannot detect (the r4 driver
+        # capture read decode_64k 33 points low this way). Repeats re-time
+        # the same compiled programs; the min positive cycle slope recovers
+        # the clean number and the spread records the contention.
+        import tree_attention_tpu.utils.profiling as prof
+        from tree_attention_tpu.utils.profiling import slope_per_step
+
+        state = {"t": 0.0, "calls": 0}
+        base = {2: 0.010 + 0.003 * 2, 10: 0.010 + 0.003 * 10}
+        made = []
+
+        def fake_fn(n):
+            made.append(n)
+
+            def run():
+                # Cycle 1 (first 4 timed calls at iters=2): 1.6x contended.
+                factor = 1.6 if state["calls"] < 4 else 1.0
+                state["calls"] += 1
+                state["t"] += base[n] * factor
+
+            return run
+
+        real = prof.time.perf_counter
+        prof.time.perf_counter = lambda: state["t"]
+        try:
+            s = slope_per_step(
+                fake_fn, n_small=2, n_large=10, iters=2, warmup=0,
+                fetch=False, stat="min", repeats=3,
+            )
+        finally:
+            prof.time.perf_counter = real
+        assert made == [2, 10]  # programs built once, reused across cycles
+        assert len(s.slopes) == 3
+        assert abs(s.per_step - 0.003) < 1e-9          # min = clean cycles
+        assert abs(s.slopes[0] - 0.0048) < 1e-9        # contended cycle
+        assert abs(s.spread_pct - 60.0) < 1e-6         # (4.8-3)/3
+
+    def test_slope_per_step_all_nonpositive_cycles_raise(self):
+        # Fake clock: every call costs exactly the same regardless of n,
+        # so the slope is exactly 0 in every cycle (a real clock would
+        # make this flaky — scheduling jitter can tip a zero slope
+        # positive by chance).
+        import tree_attention_tpu.utils.profiling as prof
+        from tree_attention_tpu.utils.profiling import slope_per_step
+
+        state = {"t": 0.0}
+
+        def flat_fn(n):
+            def run():
+                # n-independent: zero marginal cost. 2^-6 is binary-exact,
+                # so every perf_counter delta is bitwise identical and the
+                # slope is exactly 0 (0.010 left 1e-19 of representation
+                # error, enough to read as a "positive" slope).
+                state["t"] += 0.015625
+
+            return run
+
+        real = prof.time.perf_counter
+        prof.time.perf_counter = lambda: state["t"]
+        try:
+            with pytest.raises(RuntimeError, match="non-positive"):
+                slope_per_step(flat_fn, n_small=2, n_large=10, iters=1,
+                               warmup=0, fetch=False, stat="min", repeats=2)
+        finally:
+            prof.time.perf_counter = real
+        with pytest.raises(ValueError):
+            slope_per_step(flat_fn, n_small=2, n_large=10, repeats=0)
+
     def test_time_fn_fetch_fence(self):
         stats = time_fn(lambda: jnp.arange(8.0) * 2, iters=2, warmup=1,
                         fetch=True)

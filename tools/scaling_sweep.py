@@ -1,6 +1,6 @@
 """Decode-comparator N-scaling on the emulated mesh (VERDICT r4 item 2).
 
-Runs tree / ring / Ulysses decode at N = 2, 4, 8, 16, 32 virtual CPU
+Runs tree / ring / Ulysses decode at N = 2, 4, 8, 16, 32, 64 virtual CPU
 devices on the reference decode shape (q_len=1, 16 heads × 128 D) at two
 contexts, recording per-step wall clock AND HLO-parsed collective counts
 per N. The claim under test is *structural*: ring's merge is a sequential
@@ -28,7 +28,7 @@ Writes ``measurements/r5/decode_scaling.json``; bench.py attaches it as
 the ``tree_vs_ring_decode_scaling`` record.
 
 Run (hours of 1-core time; never concurrently with chip measurements):
-    python tools/scaling_sweep.py [--ns 2 4 8 16 32] [--ctxs 64000 2048]
+    python tools/scaling_sweep.py [--ns 2 4 8 16 32 64] [--ctxs 64000 2048]
 """
 
 from __future__ import annotations
@@ -76,7 +76,8 @@ def run_cell(n: int, ctx: int, iters: int, timeout: int):
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--ns", type=int, nargs="+", default=[2, 4, 8, 16, 32])
+    p.add_argument("--ns", type=int, nargs="+",
+                   default=[2, 4, 8, 16, 32, 64])
     p.add_argument("--ctxs", type=int, nargs="+", default=[64000, 2048])
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--timeout", type=int, default=3600)
@@ -115,6 +116,13 @@ def main() -> None:
         with open(args.out) as f:
             prior = json.load(f)
         if isinstance(prior, dict) and isinstance(prior.get("cells"), dict):
+            for key, cell in prior["cells"].items():
+                # Back-fill provenance for cells from before per-cell
+                # stamping: they were measured at the prior artifact's
+                # top-level commit/time, not this run's.
+                if isinstance(cell, dict) and "commit" not in cell:
+                    cell["commit"] = prior.get("commit")
+                    cell["captured_at"] = prior.get("captured_at")
             result["cells"].update(prior["cells"])
     except OSError:
         pass  # no prior artifact: a fresh sweep
@@ -152,9 +160,11 @@ def main() -> None:
                         cell[k] = rec[k]
             except Exception as e:
                 err = f"{type(e).__name__}: {e}"[:400]
-                if key in result["cells"]:
-                    # A failed re-run must not erase a prior good cell:
-                    # keep it, note the failed refresh beside it.
+                if key in result["cells"] and "error" not in result["cells"][key]:
+                    # A failed re-run must not erase a prior GOOD cell:
+                    # keep it, note the failed refresh beside it. (A prior
+                    # error cell has nothing to protect — fall through and
+                    # record the newest failure instead.)
                     result["cells"][key]["refresh_error"] = err
                     persist()
                     print(json.dumps({key: {"refresh_error": err}}),
