@@ -18,6 +18,22 @@ from tree_attention_tpu.bench.comm import (
 from tree_attention_tpu.parallel import cpu_mesh
 
 
+def _load_bench():
+    """Load repo-root bench.py as a module (it is a script, not a package
+    member); shared by every test that checks its record logic."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def test_shape_bytes_parses_arrays_and_tuples():
     assert _shape_bytes("f32[1,16,1,128]") == 16 * 128 * 4
     assert _shape_bytes("bf16[8,128]") == 8 * 128 * 2
@@ -152,17 +168,9 @@ def test_shape_bytes_async_start_takes_result_not_sum():
 def test_bench_summary_line_is_compact_and_parseable():
     """bench.py must end with a small self-sufficient JSON line (the
     driver's bounded stdout tail truncated the r3 single-line format)."""
-    import importlib.util
     import json as _json
-    import os as _os
 
-    path = _os.path.join(
-        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-        "bench.py",
-    )
-    spec = importlib.util.spec_from_file_location("bench_mod", path)
-    b = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(b)
+    b = _load_bench()
 
     suite = {
         "backend": "cpu_fallback (probe skipped)",
@@ -237,16 +245,7 @@ def test_slope_record_fields_guards():
     """bench.py's shared decode-record tail: fast readings are suspect
     (fence failure), wide spreads get the min-cycle note, clean records
     get neither (VERDICT r4 item 1)."""
-    import importlib.util
-    import os as _os
-
-    path = _os.path.join(
-        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-        "bench.py",
-    )
-    spec = importlib.util.spec_from_file_location("bench_mod2", path)
-    b = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(b)
+    b = _load_bench()
     from tree_attention_tpu.utils.profiling import SlopeStats, TimingStats
 
     ts = TimingStats(median=1, mean=1, minimum=1, maximum=1, iters=1,
