@@ -20,6 +20,8 @@ from tree_attention_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_decode,
 )
 from tree_attention_tpu.parallel.tree import (  # noqa: F401
+    MERGE_PAYLOAD_FORMATS,
+    resolve_merge_payload,
     shard_zigzag,
     tree_attention,
     tree_decode,

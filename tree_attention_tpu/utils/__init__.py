@@ -32,8 +32,11 @@ from tree_attention_tpu.utils.logging import (  # noqa: F401
     setup_logging,
 )
 from tree_attention_tpu.utils.profiling import (  # noqa: F401
+    SlopeStats,
     TimingStats,
     device_memory_stats,
+    slope_per_step,
     time_fn,
+    time_per_step,
     trace,
 )
