@@ -66,7 +66,12 @@ import subprocess
 import sys
 
 # Hardware spec constants: one definition package-wide (bench/ici.py).
+# NOTE this (via bench/__init__ -> harness) already imports jax at module
+# scope; that is safe because the TPU-vs-CPU decision happens in main()
+# via a SUBPROCESS probe plus jax.config.update before any backend init —
+# import order alone neither helps nor hurts.
 from tree_attention_tpu.bench.ici import BF16_PEAK, HBM_BW as HBM_ROOFLINE
+from tree_attention_tpu.utils.profiling import deflation_suspect
 
 BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
 
@@ -74,7 +79,7 @@ BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
 def _slope_record_fields(slope, kv_bytes):
     """Shared honest-number tail for decode records: per-step from the
     min-over-cycles slope, the cycle slopes and spread as the record's own
-    error bar, and a symmetric plausibility guard (VERDICT r4 item 1 — the
+    error bar, and symmetric plausibility guards (VERDICT r4 item 1 — the
     r4 driver capture read decode_64k 33 points below the same commit's
     earlier run with nothing in the record to say which was wrong).
     """
@@ -87,15 +92,18 @@ def _slope_record_fields(slope, kv_bytes):
         "slope_cycles_us": [round(s * 1e6, 2) for s in slope.slopes],
         "slope_spread_pct": round(slope.spread_pct, 1),
     }
+    deflated = deflation_suspect(slope)
     if bw > 1.05 * HBM_ROOFLINE:
         fields["timing_suspect"] = (
             "implied bandwidth above the HBM spec — the fetch fence did "
             "not fence; discard this record"
         )
+    elif deflated:
+        fields["timing_suspect"] = deflated
     elif slope.spread_pct > 15:
-        # Additive-noise model: only an inflated slope is possible, so the
-        # min is still the honest estimate — but a wide spread says the
-        # window was contended and the min may itself be an upper bound.
+        # Inflation-only noise: the min is still the honest estimate — but
+        # a wide spread says the window was contended and the min may
+        # itself be an upper bound.
         fields["timing_note"] = (
             f"cycle slopes spread {slope.spread_pct:.0f}%: contended "
             "window; per-step is the min cycle (noise is additive)"
@@ -296,13 +304,15 @@ def _train_record(T=4096, n_small=16, n_large=64):
         dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
         return dq + dk + dv
 
+    # repeats=3 (not 2): the deflation guard below needs >= 3 cycles to
+    # tell a deflated min from one ordinarily-contended sibling.
     s_fwd = slope_per_step(
         chain(fwd_step), q, k, v, n_small=n_small, n_large=n_large,
-        iters=5, warmup=1, stat="min", repeats=2,
+        iters=5, warmup=1, stat="min", repeats=3,
     )
     s_both = slope_per_step(
         chain(bwd_step), q, k, v, n_small=n_small, n_large=n_large,
-        iters=5, warmup=1, stat="min", repeats=2,
+        iters=5, warmup=1, stat="min", repeats=3,
     )
     per_fwd, per_both = s_fwd.per_step, s_both.per_step
     bq = default_block_q(T, T)
@@ -318,23 +328,30 @@ def _train_record(T=4096, n_small=16, n_large=64):
             "us_per_step": round(per_fwd * 1e6, 1),
             "tflops_per_sec": round(fwd_flops / per_fwd / 1e12, 1),
             "mfu_pct": round(fwd_flops / per_fwd / BF16_PEAK * 100, 1),
+            "slope_cycles_us": [round(s * 1e6, 2) for s in s_fwd.slopes],
             "slope_spread_pct": round(s_fwd.spread_pct, 1),
         },
         "fwd_bwd": {
             "us_per_step": round(per_both * 1e6, 1),
             "tflops_per_sec": round(both_flops / per_both / 1e12, 1),
             "mfu_pct": round(both_flops / per_both / BF16_PEAK * 100, 1),
+            "slope_cycles_us": [round(s * 1e6, 2) for s in s_both.slopes],
             "slope_spread_pct": round(s_both.spread_pct, 1),
         },
     }
-    # Same physical-plausibility fence as the decode records: >100% MFU is
-    # not a fast chip, it is a fence that did not fence. The flag keeps the
+    # Same physical-plausibility fences as the decode records: >100% MFU is
+    # not a fast chip, it is a fence that did not fence, and a min cycle
+    # far below the median cycle is a deflated fetch. The flag keeps the
     # record out of the evidence replay and the pricing model's inputs.
     if any(rec[p]["mfu_pct"] > 100 for p in ("fwd", "fwd_bwd")):
         rec["timing_suspect"] = (
             "MFU above the bf16 peak — the fetch fence did not fence; "
             "discard this record"
         )
+    else:
+        deflated = deflation_suspect(s_fwd) or deflation_suspect(s_both)
+        if deflated:
+            rec["timing_suspect"] = deflated
     return rec
 
 

@@ -267,3 +267,28 @@ def test_slope_record_fields_guards():
 
     _, f = b._slope_record_fields(slope(clean, 38.4, (clean, clean * 1.4)), kv)
     assert "timing_note" in f and "timing_suspect" not in f
+
+    # Deflation fault: a min cycle far below the median cycle is an
+    # early-resolved fetch even when its implied bandwidth stays under the
+    # spec ceiling (observed 2026-08-01: sub-peak but impossible sweep
+    # cells in a bad transport window).
+    slow = kv / (0.5 * b.HBM_ROOFLINE)       # contended window: 50% roofline
+    deflated = 0.55 * slow                   # "faster" cycle, still sub-spec
+    _, f = b._slope_record_fields(
+        slope(deflated, 80.0, (deflated, slow, slow * 1.02)), kv
+    )
+    assert "timing_suspect" in f and "deflation" in f["timing_suspect"]
+    assert f["pct_hbm_roofline"] < 105  # the ceiling guard alone misses it
+
+    # The r5 q8q capture's shape ([359, 359, 497]): min == median, genuine
+    # contention — stays a note, not a suspect flag.
+    _, f = b._slope_record_fields(
+        slope(clean, 38.4, (clean, clean, clean * 1.38)), kv
+    )
+    assert "timing_note" in f and "timing_suspect" not in f
+
+    # With only two cycles, median == mean and the deflation test cannot
+    # tell a deflated min from one contended sibling — it must stay quiet
+    # (callers that want the defence run repeats >= 3).
+    _, f = b._slope_record_fields(slope(slow, 150.0, (slow, slow * 2.5)), kv)
+    assert "timing_suspect" not in f

@@ -241,6 +241,43 @@ class TestProfiling:
                         fetch=True)
         assert stats.iters == 2
 
+    def test_deflation_suspect_rules(self):
+        # The min-stat estimator assumes contention only inflates a cycle;
+        # deflation_suspect is the defence for the observed counterexample
+        # (2026-08-01: the tunnel resolved fetches early, deflating cycles
+        # by ~2x while staying under the physical ceilings).
+        from tree_attention_tpu.utils.profiling import (
+            SlopeStats,
+            deflation_suspect,
+            time_fn,
+        )
+
+        ts = time_fn(lambda: None, iters=1, warmup=0, fetch=False)
+
+        def stats(slopes):
+            pos = [s for s in slopes if s > 0]
+            return SlopeStats(
+                per_step=min(pos), slopes=tuple(slopes),
+                spread_pct=(max(pos) - min(pos)) / min(pos) * 100,
+                small=ts, large=ts,
+            )
+
+        # Deflated min among >= 3 cycles: flagged.
+        assert "deflation" in deflation_suspect(stats((0.5, 1.0, 1.02)))
+        # Genuine contention (min == median): quiet.
+        assert deflation_suspect(stats((1.0, 1.0, 1.4))) is None
+        # Two cycles can't distinguish the cases: quiet even at 2.5x
+        # (the caller chose repeats < 3; that is its documented contract).
+        assert deflation_suspect(stats((1.0, 2.5))) is None
+        # ANY non-positive cycle is hard evidence of a faulty window —
+        # a chain cannot cost nothing — and flags the record even when
+        # enough clean-looking siblings survive ("could not check" must
+        # not read as "checked and clean").
+        for slopes in ((-0.1, 0.5, 1.0, 1.02), (-0.1, -0.2, 1.0),
+                       (-0.1, 1.0, 1.0, 1.02)):
+            reason = deflation_suspect(stats(slopes))
+            assert reason is not None and "non-positive" in reason
+
     def test_time_fn_rejects_zero_iters(self):
         with pytest.raises(ValueError):
             time_fn(lambda: None, iters=0)

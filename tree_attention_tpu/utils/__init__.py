@@ -32,8 +32,11 @@ from tree_attention_tpu.utils.logging import (  # noqa: F401
     setup_logging,
 )
 from tree_attention_tpu.utils.profiling import (  # noqa: F401
+    DEFLATION_MIN_CYCLES,
+    DEFLATION_RATIO,
     SlopeStats,
     TimingStats,
+    deflation_suspect,
     device_memory_stats,
     slope_per_step,
     time_fn,

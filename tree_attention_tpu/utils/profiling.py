@@ -113,6 +113,63 @@ class SlopeStats:
     large: TimingStats
 
 
+DEFLATION_MIN_CYCLES = 3
+DEFLATION_RATIO = 0.6
+
+
+def deflation_suspect(slope: "SlopeStats") -> Optional[str]:
+    """Reason string when the min cycle looks DEFLATED, else None.
+
+    The additive-noise model behind the min-stat estimator (contention
+    only ever inflates a cycle) failed on 2026-08-01: in a bad transport
+    window the tunnel resolved fetches before the chained program had
+    finished, producing cycle slopes up to ~2x too FAST — some below the
+    physical roofline (caught by the bandwidth/MFU ceiling guards), some
+    not (a 16k fwd sweep cell read 194 TFLOP/s on a 197-peak chip). A
+    deflated cycle shows up as the min sitting far below the median of
+    its siblings (< ``DEFLATION_RATIO`` x); genuine contention (e.g. the
+    r5 q8q capture's [359, 359, 497] us) keeps min ~= median.
+
+    Needs at least ``DEFLATION_MIN_CYCLES`` positive cycles: with two,
+    median == mean and the test would flag one ordinarily-contended
+    cycle at >2.33x as a deflated min. Callers that want this defence
+    must run ``repeats >= 3``.
+
+    Known bound of the defence: a fault window long enough to deflate
+    MOST cycles by a similar factor keeps min ~= median and passes this
+    screen — by construction no intra-run statistic can separate that
+    from a genuinely clean capture. The remaining nets for that case are
+    the physical-ceiling guards (a whole-window deflation large enough
+    to matter usually crosses the bandwidth/MFU spec, as the 2026-08-01
+    sweep cells did) and cross-capture comparison: records publish their
+    ``slope_cycles_us`` + commit + timestamp precisely so a later reader
+    can diff same-shape captures across runs.
+    """
+    positive = [s for s in slope.slopes if s > 0]
+    if len(positive) < len(slope.slopes):
+        # A non-positive cycle is hard evidence of a faulty window on its
+        # own — a chain cannot cost nothing — regardless of how many
+        # clean-looking siblings survive: the surviving min is data from
+        # the same window that produced the nonsense cycles. "Could not
+        # check" must not read as "checked and clean". (Flagging costs
+        # only a re-run.)
+        return (
+            f"only {len(positive)} of {len(slope.slopes)} cycle slopes "
+            "positive: the non-positive cycles signal a faulty transport "
+            "window; discard this record"
+        )
+    if len(positive) >= DEFLATION_MIN_CYCLES:
+        med = statistics.median(positive)
+        if slope.per_step < DEFLATION_RATIO * med:
+            return (
+                f"min cycle {slope.per_step * 1e6:.0f} us is "
+                f"<{DEFLATION_RATIO}x the median cycle {med * 1e6:.0f} us: "
+                "transport deflation fault suspected (fetch resolved "
+                "early); discard this record"
+            )
+    return None
+
+
 def slope_per_step(
     make_fn: Callable[[int], Callable[..., Any]],
     *args: Any,
