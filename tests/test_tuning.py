@@ -35,8 +35,8 @@ def test_q8_tiles_at_least_exact_tiles():
 def test_train_tiles_bucketed_by_seq_len():
     bq4k, bk4k = default_block_q(4096, 4096), default_block_size("pallas", 4096)
     bq16k = default_block_q(16_384, 16_384)
-    assert (bq4k, bk4k) == (512, 2048)
-    assert bq16k >= bq4k  # deeper Q tile measured faster at long seq
+    assert (bq4k, bk4k) == (1024, 1024)  # 2026-08-01 A/B (ab_fwd_tiles.py)
+    assert bq16k >= bq4k  # deeper Q tile never measured slower at long seq
     # blockwise keeps its own (unmeasured-by-the-campaign) default; the
     # Pallas-measured table must not leak into the XLA fallback (ADVICE r3).
     from tree_attention_tpu.ops.tuning import BLOCKWISE_BLOCK_K
@@ -45,15 +45,31 @@ def test_train_tiles_bucketed_by_seq_len():
 
 
 def test_bwd_default_block_q_vmem_capped():
-    # The bwd kernels' per-tile live state VMEM-OOMs at the fwd-optimal
-    # deep tile; the bwd default must never exceed the cap, while the fwd
-    # default is allowed to (measured faster at 16k).
-    from tree_attention_tpu.ops.tuning import BWD_MAX_BLOCK_Q, default_block_q_bwd
+    # The bwd kernels' per-tile live state VMEM-OOMs when bq * bk exceeds
+    # the measured-feasible product ((1024, 2048) = 24.6 MB scoped VMEM vs
+    # the 16 MB chip limit); the bwd default must respect the product cap
+    # for WHATEVER KV tile was resolved — including caller-supplied ones —
+    # while never exceeding the largest validated Q tile.
+    from tree_attention_tpu.ops.tuning import (
+        BWD_MAX_BLOCK_Q,
+        BWD_MAX_TILE_ELEMS,
+        default_block_q_bwd,
+    )
 
     for t in (128, 4096, 8192, 16_384, 1 << 20):
-        assert default_block_q_bwd(t, t) <= BWD_MAX_BLOCK_Q
-        assert default_block_q_bwd(t, t) <= default_block_q(t, t)
-    assert default_block_q(16_384, 16_384) > BWD_MAX_BLOCK_Q
+        for bk in (None, 512, 1024, 2048, 4096, 16_384):
+            bq = default_block_q_bwd(t, t, bk)
+            assert bq <= BWD_MAX_BLOCK_Q
+            assert bq <= default_block_q(t, t)
+            if bk is not None:
+                # The product cap holds for EVERY caller-supplied KV
+                # tile — no floor may push bq * bk back above it.
+                assert bq * bk <= BWD_MAX_TILE_ELEMS
+    # The table default (bk=1024) now admits the full 1024-row bwd tile
+    # (the retune measured 1.18x at 4k fwd+bwd through the product default
+    # path); an explicit bk=2048 halves it back.
+    assert default_block_q_bwd(16_384, 16_384) == 1024
+    assert default_block_q_bwd(16_384, 16_384, 2048) == 512
 
 
 def test_decode_kernel_resolves_none_block_size():

@@ -108,14 +108,20 @@ def main():
     # and confound the fwd-tile comparison), and all three grads are
     # computed and folded — grad-wrt-q alone lets XLA dead-code-eliminate
     # the dKV kernel (~5 of the 9 backward matmul passes). ---
-    from tree_attention_tpu.ops.tuning import default_block_q_bwd
     from tree_attention_tpu.ops.vjp import flash_attention_vjp
 
     T = 16384
     q, k, v = qkv(T)
-    bq_bwd = default_block_q_bwd(T, T)
+    # Pinned to the literal value the recorded r4 artifacts ran with
+    # (bench_r4_full.jsonl fwd_bwd_tiles logs bq_bwd=512). The live
+    # default (tuning.default_block_q_bwd) moved in r5 — keyed by the
+    # actual bk via the BWD_MAX_TILE_ELEMS product cap — and calling it
+    # here would either OOM (flat call: bq_bwd=1024 at bk>=2048) or
+    # change the measured config (per-cell call: 256/128 at the larger
+    # bk cells); this script stays exactly as its artifacts ran.
+    bq_bwd = 512
     for bq, bk in ((1024, 2048), (512, 4096), (256, 8192)):
-        def both(q_, k_, v_):
+        def both(q_, k_, v_, bq=bq, bk=bk, bq_bwd=bq_bwd):
             def loss(q__, k__, v__):
                 o, _ = flash_attention_vjp(
                     q__, k__, v__, causal=True, impl="pallas",

@@ -193,7 +193,11 @@ def flash_attention(
             block_size = default_block_size(impl, k.shape[2])
         if block_q is None and impl == "pallas":
             block_q = default_block_q(q.shape[2], k.shape[2])
-            block_q_bwd = default_block_q_bwd(q.shape[2], k.shape[2])
+            # The resolved KV tile (possibly caller-supplied) bounds the
+            # bwd Q tile: VMEM feasibility scales with bq * bk.
+            block_q_bwd = default_block_q_bwd(
+                q.shape[2], k.shape[2], block_size
+            )
     if impl == "naive":
         # Raw autodiff path: the differential oracle the custom VJP is
         # tested against.

@@ -20,6 +20,8 @@ dispatcher and the custom VJP.
 
 from __future__ import annotations
 
+from typing import Optional
+
 # context-length upper bound -> block_k. Measured on v5e (tools/tune_sweep.py
 # round 2; tools/experiments_r3.py 2026-07-31): bigger contexts amortise the
 # ~360 ns/tile fixed cost over more streaming — 64k MHA measures 92.5% of
@@ -69,15 +71,22 @@ def tpu_kernel_for(tq: int) -> str:
 
 
 # (seq-length upper bound, block_q, block_k) for the Q-tiled training
-# kernel. Measured by tools/measure_campaign.py + tools/experiments_r3.py
-# on v5e, 2026-07-31 (min-stat slope protocol): (512, 2048) wins the fwd
-# sweep at 4k (879 us, 78 TFLOP/s — the round-1 (256, 512) defaults measure
-# 2.5x slower) and the fwd+bwd sweep at 4k (2.0 ms, ~119 TFLOP/s); at 16k
-# the deeper Q tile (1024, 2048) wins fwd (9.9 ms, 111.5 TFLOP/s vs 102.3
-# for bq=512). Both kernels clamp tiles to the actual shape, so the table
-# is safe for short sequences too.
+# kernel. Re-measured on v5e 2026-08-01 (tools/ab_fwd_tiles.py, min-stat
+# repeated-slope protocol with deflation screens, after the round-5
+# lane-replicated-state and prefetch-zero-culling kernel changes made the
+# round-3 table stale): (1024, 1024) wins through 32k — 4k fwd+bwd
+# 3.29 -> 2.79 ms (1.18x) vs the old (512, 2048) through the product
+# default path, 16k fwd+bwd 36.45 -> 35.24 ms, 32k 133.8 -> 132.4 ms —
+# and the smaller KV tile halves the backward kernels' VMEM so their Q
+# tile can double (see BWD_MAX_TILE_ELEMS below). At 64k the bases tie
+# and at 128k the deeper KV tile is ~1% faster (bench train records,
+# same day), so the long bucket keeps (1024, 2048). Wall-clock per model
+# step is the comparison basis — the launched-tile MFU shrinks with
+# finer tiles because less diagonal waste is launched at all. Both
+# kernels clamp tiles to the actual shape, so the table is safe for
+# short sequences too.
 _TRAIN_TILES = (
-    (8192, 512, 2048),
+    (32768, 1024, 1024),
     (float("inf"), 1024, 2048),
 )
 
@@ -104,14 +113,20 @@ def default_block_size(impl: str, tk: int) -> int:
     return BLOCKWISE_BLOCK_K
 
 
-# VMEM ceiling for the backward kernels' Q tile. The bwd kernels hold more
+# VMEM ceiling for the backward kernels' tiles. The bwd kernels hold more
 # per-tile live state than the forward (recomputed s/p/ds alongside the
-# dq/dkv accumulators): (bq=1024, bk=2048) measures 24.6 MB of scoped VMEM
-# against the v5e's 16 MB limit — a compile-time OOM (observed 2026-07-31,
-# T=16384). Applied only when the tile comes from this table's defaults;
-# an explicitly passed block_q always wins unchanged (sweeps must measure
-# what they label).
-BWD_MAX_BLOCK_Q = 512
+# dq/dkv accumulators), and the dominant term scales with bq*bk:
+# (1024, 2048) measures 24.6 MB of scoped VMEM against the v5e's 16 MB
+# limit — a compile-time OOM (observed 2026-07-31, T=16384) — while
+# (1024, 1024) and (512, 2048) both compile and run (the former measured
+# fastest in the 2026-08-01 A/B). The cap is therefore a product bound,
+# not a bare block_q bound. Applied only when the tile comes from this
+# table's defaults; an explicitly passed block_q always wins unchanged
+# (sweeps must measure what they label).
+BWD_MAX_TILE_ELEMS = 1024 * 1024
+# Largest bwd Q tile ever validated on-chip; the product bound alone
+# would allow (2048, 512), which no sweep has measured.
+BWD_MAX_BLOCK_Q = 1024
 
 
 def default_block_q(tq: int, tk: int) -> int:
@@ -119,6 +134,25 @@ def default_block_q(tq: int, tk: int) -> int:
     return _train_tile(tq)[0]
 
 
-def default_block_q_bwd(tq: int, tk: int) -> int:
-    """Q-tile length for the Pallas backward kernels (VMEM-capped)."""
-    return min(default_block_q(tq, tk), BWD_MAX_BLOCK_Q)
+def default_block_q_bwd(tq: int, tk: int, block_k: Optional[int] = None) -> int:
+    """Q-tile length for the Pallas backward kernels (VMEM-capped).
+
+    ``block_k`` is the RESOLVED KV tile the backward kernels will run
+    with (it may be caller-supplied rather than this table's default);
+    the cap keeps ``bq * bk`` within the measured VMEM-feasible product.
+    The fallback mirrors ``default_block_size("pallas", tk)`` — keyed by
+    the KV length, exactly what the dispatcher would resolve — so a
+    direct caller that omits ``block_k`` gets a cap consistent with the
+    tile the kernels will actually run.
+    """
+    if block_k is None:
+        block_k = _train_tile(tk)[1]
+    # No lower floor above the kernels' own minimum (they clamp bq to
+    # >= 8): flooring at, say, 128 rows would silently emit a product
+    # ABOVE the cap for a huge caller-supplied KV tile (bk=16384 ->
+    # 128 * 16384 = 2M elems, the documented compile-OOM class).
+    return min(
+        default_block_q(tq, tk),
+        BWD_MAX_BLOCK_Q,
+        max(8, BWD_MAX_TILE_ELEMS // max(block_k, 1)),
+    )

@@ -36,6 +36,7 @@ from tree_attention_tpu.utils.profiling import (  # noqa: F401
     DEFLATION_RATIO,
     SlopeStats,
     TimingStats,
+    chain_slope,
     deflation_suspect,
     device_memory_stats,
     slope_per_step,

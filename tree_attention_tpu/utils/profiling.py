@@ -250,6 +250,54 @@ def slope_per_step(
     )
 
 
+def chain_slope(
+    step: Callable[..., Any],
+    carry: Any,
+    *rest: Any,
+    n_small: int,
+    n_large: int,
+    iters: int = 5,
+    warmup: int = 1,
+    stat: str = "min",
+    repeats: int = 3,
+) -> SlopeStats:
+    """Slope-time ``step`` via an on-device dependent chain.
+
+    The one blessed harness for per-step kernel timing on the tunneled
+    transport, used by every live caller (bench.py's decode/q8/train
+    records and the tile A/B; ``tools/experiments_r4.py`` keeps its own
+    copy because it is the frozen round-4 measurement script, kept
+    exactly as its recorded artifacts ran): ``step(carry, *rest) ->
+    next_carry`` is chained
+    ``n`` times under ``lax.scan`` (each step consumes the previous
+    output, so nothing can overlap or be elided), the chain returns a
+    SCALAR reduction of the final carry (a full-tensor fetch costs
+    seconds of heavy-tailed RPC per call that the slope would then have
+    to cancel), and the (small, large) chain pair goes through
+    :func:`slope_per_step`'s min-stat repeated-cycle protocol. Callers
+    that need gradients or multi-output steps fold them into the carry
+    themselves — XLA dead-code-eliminates any output that does not feed
+    the carry chain.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def mk(n):
+        def f(c, *r):
+            def body(cc, _):
+                return step(cc, *r).astype(cc.dtype), None
+
+            out = lax.scan(body, c, None, length=n)[0]
+            return jnp.sum(out.astype(jnp.float32))
+
+        return jax.jit(f)
+
+    return slope_per_step(
+        mk, carry, *rest, n_small=n_small, n_large=n_large,
+        iters=iters, warmup=warmup, stat=stat, repeats=repeats,
+    )
+
+
 def time_per_step(
     make_fn: Callable[[int], Callable[..., Any]],
     *args: Any,
