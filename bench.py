@@ -544,6 +544,13 @@ def _tree_vs_ring_decode_record():
             )
         except Exception as e:
             rec[f"ctx_{ctx}"] = {"error": f"{type(e).__name__}: {e}"}
+    # Observed ranges live in the note string only (update it when a new
+    # round's captures move them).
+    rec["wall_clock_note"] = (
+        "emulated ratios are scheduling-noisy (observed r5 ranges: "
+        "ctx_64000 0.89-0.99x, ctx_2048 1.05-2.2x); read the comm blocks "
+        "and the N-scaling artifact, not any single ratio"
+    )
     return rec
 
 
