@@ -70,18 +70,24 @@ import sys
 # scope; that is safe because the TPU-vs-CPU decision happens in main()
 # via a SUBPROCESS probe plus jax.config.update before any backend init —
 # import order alone neither helps nor hurts.
+from tree_attention_tpu import obs
 from tree_attention_tpu.bench.ici import BF16_PEAK, HBM_BW as HBM_ROOFLINE
-from tree_attention_tpu.utils.profiling import deflation_suspect
+from tree_attention_tpu.utils.profiling import (
+    deflation_suspect,
+    record_guard_verdict,
+)
 
 BASELINE_TOKENS_PER_SEC = 64000 / 5.74  # reference model.py on survey CPU
 
 
-def _slope_record_fields(slope, kv_bytes):
+def _slope_record_fields(slope, kv_bytes, name=""):
     """Shared honest-number tail for decode records: per-step from the
     min-over-cycles slope, the cycle slopes and spread as the record's own
     error bar, and symmetric plausibility guards (VERDICT r4 item 1 — the
     r4 driver capture read decode_64k 33 points below the same commit's
     earlier run with nothing in the record to say which was wrong).
+    Verdicts also file into the telemetry registry under ``name``
+    (guard counters + trace instants) when a run armed it.
     """
     per_step = slope.per_step
     bw = kv_bytes / per_step
@@ -92,14 +98,23 @@ def _slope_record_fields(slope, kv_bytes):
         "slope_cycles_us": [round(s * 1e6, 2) for s in slope.slopes],
         "slope_spread_pct": round(slope.spread_pct, 1),
     }
+    # Each screen fires (and files its verdict) independently — a ceiling
+    # trip must not mask the deflation annotation, the same
+    # one-guard-masks-another shape the _train_record fix removes; the
+    # record's timing_suspect concatenates every reason.
+    reasons = []
     deflated = deflation_suspect(slope)
     if bw > 1.05 * HBM_ROOFLINE:
-        fields["timing_suspect"] = (
+        reasons.append(
             "implied bandwidth above the HBM spec — the fetch fence did "
             "not fence; discard this record"
         )
-    elif deflated:
-        fields["timing_suspect"] = deflated
+        record_guard_verdict(name, "ceiling", reasons[-1])
+    if deflated:
+        reasons.append(deflated)
+        record_guard_verdict(name, "deflation", deflated)
+    if reasons:
+        fields["timing_suspect"] = "; ".join(reasons)
     elif slope.spread_pct > 15:
         # Inflation-only noise: the min is still the honest estimate — but
         # a wide spread says the window was contended and the min may
@@ -108,6 +123,9 @@ def _slope_record_fields(slope, kv_bytes):
             f"cycle slopes spread {slope.spread_pct:.0f}%: contended "
             "window; per-step is the min cycle (noise is additive)"
         )
+        record_guard_verdict(name, "jitter", fields["timing_note"])
+    else:
+        record_guard_verdict(name, "clean")
     return per_step, fields
 
 
@@ -155,7 +173,9 @@ def _decode_record(H, Hkv, T, n_small, n_large, block_size=None):
         raise RuntimeError(f"all impls failed: {errors}")
 
     kv_bytes = 2 * T * Hkv * D * 2
-    per_step, fields = _slope_record_fields(slope, kv_bytes)
+    per_step, fields = _slope_record_fields(
+        slope, kv_bytes, name=f"decode_ctx{T}"
+    )
     rec = {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
                      "head_dim": D, "dtype": "bfloat16", "q_len": 1,
@@ -207,7 +227,9 @@ def _decode_q8_record(H, Hkv, T, n_small, n_large, q_quant=False):
         step, q, k_q, v_q, n_small=n_small, n_large=n_large, repeats=3,
     )
     kv_bytes = 2 * T * Hkv * D  # int8: one byte per element
-    per_step, fields = _slope_record_fields(slope, kv_bytes)
+    per_step, fields = _slope_record_fields(
+        slope, kv_bytes, name=f"decode_{quant_kernel}_ctx{T}"
+    )
     return {
         "workload": {"heads": H, "kv_heads": Hkv, "context": T,
                      "head_dim": D, "kv_dtype": "int8", "q_len": 1,
@@ -309,15 +331,24 @@ def _train_record(T=4096, n_small=16, n_large=64):
     # not a fast chip, it is a fence that did not fence, and a min cycle
     # far below the median cycle is a deflated fetch. The flag keeps the
     # record out of the evidence replay and the pricing model's inputs.
+    # Both guards run unconditionally (ADVICE r5): a pass tripping the MFU
+    # ceiling must not suppress the (more actionable) deflation annotation
+    # for the other pass — the reasons concatenate.
+    reasons = []
     if any(rec[p]["mfu_pct"] > 100 for p in ("fwd", "fwd_bwd")):
-        rec["timing_suspect"] = (
+        reasons.append(
             "MFU above the bf16 peak — the fetch fence did not fence; "
             "discard this record"
         )
+        record_guard_verdict(f"train_{T}", "ceiling", reasons[-1])
+    deflated = deflation_suspect(s_fwd) or deflation_suspect(s_both)
+    if deflated:
+        reasons.append(deflated)
+        record_guard_verdict(f"train_{T}", "deflation", deflated)
+    if reasons:
+        rec["timing_suspect"] = "; ".join(reasons)
     else:
-        deflated = deflation_suspect(s_fwd) or deflation_suspect(s_both)
-        if deflated:
-            rec["timing_suspect"] = deflated
+        record_guard_verdict(f"train_{T}", "clean")
     return rec
 
 
@@ -328,6 +359,12 @@ def _comparator_subprocess(args, timeout=900):
     JAX init). Returns the CLI's JSON record."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # The child is a single process with no rank contract: inherited
+    # telemetry sinks would resolve to the PARENT's paths and truncate the
+    # trace file it still has open. The parent's registry already counts
+    # the comparator phase via its own spans/counters.
+    env.pop("TA_METRICS_OUT", None)
+    env.pop("TA_TRACE_EVENTS", None)
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
@@ -544,12 +581,19 @@ def _tree_vs_ring_decode_record():
             )
         except Exception as e:
             rec[f"ctx_{ctx}"] = {"error": f"{type(e).__name__}: {e}"}
-    # Observed ranges live in the note string only (update it when a new
-    # round's captures move them).
+    # The note derives from THIS run's measured ratios (ADVICE r5: a
+    # hardcoded historical range goes silently stale) — the point stands on
+    # its own: emulated wall clock prices collectives at memcpy cost, so
+    # only the comm blocks and the N-scaling artifact transfer.
+    measured = ", ".join(
+        f"{ctx} tree/ring {sub['tree_speedup_vs_ring']}x"
+        for ctx, sub in rec.items()
+        if isinstance(sub, dict) and "tree_speedup_vs_ring" in sub
+    )
     rec["wall_clock_note"] = (
-        "emulated ratios are scheduling-noisy (observed r5 ranges: "
-        "ctx_64000 0.89-0.99x, ctx_2048 1.05-2.2x); read the comm blocks "
-        "and the N-scaling artifact, not any single ratio"
+        "emulated ratios are scheduling-noisy; this run measured "
+        f"{measured or 'no healthy sub-run'} — read the comm blocks and "
+        "the N-scaling artifact, not any single ratio"
     )
     return rec
 
@@ -674,11 +718,25 @@ def _load_evidence():
 
 
 def main() -> None:
+    # Telemetry is env-armed here (TA_METRICS_OUT / TA_TRACE_EVENTS — this
+    # entry point has no flags by contract: the driver parses its stdout);
+    # unarmed, every obs call below is a no-op flag check. The snapshot
+    # writes in a finally: a crash (or Ctrl-C) after hours of records must
+    # not lose the counters those records already filed.
+    obs.configure()
+    try:
+        _run_suite()
+    finally:
+        obs.shutdown()
+
+
+def _run_suite() -> None:
     suite = {}
 
     def run(name, fn, *args, **kwargs):
         try:
-            suite[name] = fn(*args, **kwargs)
+            with obs.span(f"bench:{name}", cat="bench"):
+                suite[name] = fn(*args, **kwargs)
         except Exception as e:  # keep the rest of the suite alive
             suite[name] = {"error": f"{type(e).__name__}: {e}"}
 

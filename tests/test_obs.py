@@ -1,0 +1,413 @@
+"""Telemetry subsystem: registry semantics, exports, tracer, overhead.
+
+Fast tier (no ``slow`` marker). Covers the ISSUE-1 contracts:
+
+- counter/gauge/histogram semantics and label handling;
+- Prometheus/JSON export agreement (round-trip through a minimal text
+  parser);
+- span nesting and JSONL validity (every emitted line ``json.loads``);
+- the disabled fast path is allocation-free (the guard that keeps hot-path
+  instrumentation overhead-free when telemetry is off);
+- integration: a CPU decode CLI run with ``--metrics-out``/``--trace-events``
+  emits nonzero token + collective-payload counters and well-formed trace
+  events.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import tracemalloc
+
+import pytest
+
+from tree_attention_tpu.obs.metrics import MetricsRegistry
+from tree_attention_tpu.obs.tracing import SpanTracer, _NOOP_SPAN
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _enabled_registry():
+    reg = MetricsRegistry()
+    reg.enable()
+    return reg
+
+
+class TestCounter:
+    def test_inc_and_value(self):
+        reg = _enabled_registry()
+        c = reg.counter("steps_total", "steps")
+        c.inc()
+        c.inc(41)
+        assert c.value() == 42
+
+    def test_negative_increment_rejected(self):
+        c = _enabled_registry().counter("c_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_disabled_is_noop(self):
+        reg = MetricsRegistry()  # starts disabled
+        c = reg.counter("c_total")
+        c.inc(100)
+        assert c.value() == 0
+        reg.enable()
+        c.inc(1)
+        assert c.value() == 1
+        reg.disable()
+        c.inc(100)
+        assert c.value() == 1
+
+    def test_thread_safety(self):
+        reg = _enabled_registry()
+        c = reg.counter("c_total")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == 8000
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = _enabled_registry().gauge("fill")
+        g.set(10)
+        g.inc(5)
+        g.dec(3)
+        assert g.value() == 12
+
+
+class TestHistogram:
+    def test_bucket_counts_cumulative_export(self):
+        reg = _enabled_registry()
+        h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        (sample,) = _find(reg.snapshot(), "lat_seconds")["samples"]
+        assert sample["count"] == 5
+        assert sample["sum"] == pytest.approx(56.05)
+        # Cumulative per the Prometheus le convention.
+        assert sample["buckets"] == [
+            [0.1, 1], [1.0, 3], [10.0, 4], ["+Inf", 5],
+        ]
+
+    def test_boundary_lands_in_its_bucket(self):
+        reg = _enabled_registry()
+        h = reg.histogram("h", buckets=(1.0, 2.0))
+        h.observe(1.0)  # le="1.0" includes the bound
+        (sample,) = _find(reg.snapshot(), "h")["samples"]
+        assert sample["buckets"][0] == [1.0, 1]
+
+    def test_empty_buckets_rejected(self):
+        with pytest.raises(ValueError):
+            _enabled_registry().histogram("h", buckets=())
+
+
+class TestLabels:
+    def test_children_are_independent(self):
+        reg = _enabled_registry()
+        c = reg.counter("x_total", labels=("impl",))
+        c.labels(impl="pallas").inc(2)
+        c.labels(impl="naive").inc(3)
+        assert c.labels(impl="pallas").value() == 2
+        assert c.labels(impl="naive").value() == 3
+
+    def test_labels_cached(self):
+        c = _enabled_registry().counter("x_total", labels=("a",))
+        assert c.labels(a="1") is c.labels(a="1")
+
+    def test_wrong_label_names_raise(self):
+        c = _enabled_registry().counter("x_total", labels=("a",))
+        with pytest.raises(ValueError):
+            c.labels(b="1")
+        with pytest.raises(ValueError):
+            c.labels(a="1", b="2")
+
+    def test_mutating_labeled_parent_raises(self):
+        c = _enabled_registry().counter("x_total", labels=("a",))
+        with pytest.raises(ValueError):
+            c.inc()
+
+    def test_invalid_names_rejected(self):
+        reg = _enabled_registry()
+        with pytest.raises(ValueError):
+            reg.counter("bad name")
+        with pytest.raises(ValueError):
+            reg.counter("ok_total", labels=("bad-label",))
+
+
+class TestRegistry:
+    def test_reregistration_idempotent(self):
+        reg = _enabled_registry()
+        a = reg.counter("c_total", labels=("x",))
+        b = reg.counter("c_total", labels=("x",))
+        assert a is b
+
+    def test_conflicting_redeclaration_raises(self):
+        reg = _enabled_registry()
+        reg.counter("c_total")
+        with pytest.raises(ValueError):
+            reg.gauge("c_total")
+        with pytest.raises(ValueError):
+            reg.counter("c_total", labels=("x",))
+
+    def test_reset_keeps_registrations(self):
+        reg = _enabled_registry()
+        c = reg.counter("c_total")
+        c.inc(5)
+        reg.reset()
+        assert c.value() == 0
+        c.inc(1)
+        assert c.value() == 1
+
+
+def _find(snapshot, name):
+    (m,) = [m for m in snapshot["metrics"] if m["name"] == name]
+    return m
+
+
+def _parse_prometheus(text):
+    """Minimal text-format parser: {series_name: {frozen_labels: value}}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, value = line.rsplit(" ", 1)
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            labels = {}
+            for pair in filter(None, rest.rstrip("}").split(",")):
+                k, _, v = pair.partition("=")
+                labels[k] = v.strip('"')
+            key = frozenset(labels.items())
+        else:
+            name, key = head, frozenset()
+        out.setdefault(name, {})[key] = float(value)
+    return out
+
+
+class TestExports:
+    def test_json_prometheus_round_trip(self):
+        reg = _enabled_registry()
+        c = reg.counter("tok_total", "tokens", labels=("mode",))
+        c.labels(mode="decode").inc(7)
+        g = reg.gauge("cap")
+        g.set(4096)
+        h = reg.histogram("lat_seconds", buckets=(0.5, 5.0))
+        h.observe(0.1)
+        h.observe(1.0)
+
+        snap = json.loads(reg.to_json())  # JSON export parses
+        prom = _parse_prometheus(reg.to_prometheus())
+
+        assert prom["tok_total"][frozenset({("mode", "decode")})] == 7
+        assert prom["cap"][frozenset()] == 4096
+        # Histogram series agree with the JSON cumulative buckets
+        # (normalise the le spelling: text format prints 5.0 as "5").
+        def le_key(le):
+            return le if le == "+Inf" else float(le)
+
+        prom_buckets = {}
+        for key, v in prom["lat_seconds_bucket"].items():
+            (le_val,) = [lv for lk, lv in key if lk == "le"]
+            prom_buckets[le_key(le_val)] = v
+        (sample,) = _find(snap, "lat_seconds")["samples"]
+        for le, cum in sample["buckets"]:
+            assert prom_buckets[le_key(le)] == cum
+        assert prom["lat_seconds_count"][frozenset()] == sample["count"]
+        assert prom["lat_seconds_sum"][frozenset()] == pytest.approx(
+            sample["sum"]
+        )
+
+    def test_label_value_escaping(self):
+        reg = _enabled_registry()
+        c = reg.counter("c_total", labels=("err",))
+        c.labels(err='oops "quoted"\nnewline\\slash').inc()
+        text = reg.to_prometheus()
+        # One line per sample even with an embedded newline in the value.
+        (line,) = [
+            ln for ln in text.splitlines() if ln.startswith("c_total{")
+        ]
+        assert '\\"quoted\\"' in line and "\\n" in line
+
+    def test_write_json(self, tmp_path):
+        reg = _enabled_registry()
+        reg.counter("c_total").inc()
+        path = tmp_path / "metrics.json"
+        reg.write_json(str(path))
+        data = json.loads(path.read_text())
+        assert _find(data, "c_total")["samples"][0]["value"] == 1
+        assert "process_index" in data
+
+
+class TestTracer:
+    def test_span_nesting_and_jsonl_validity(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        tracer = SpanTracer()
+        tracer.start(str(path))
+        with tracer.span("outer", args={"phase": 1}):
+            with tracer.span("inner"):
+                pass
+        tracer.instant("verdict", args={"guard": "clean"})
+        tracer.close()
+
+        events = [json.loads(line) for line in path.read_text().splitlines()]
+        assert events, "no events emitted"
+        complete = {e["name"]: e for e in events if e["ph"] == "X"}
+        assert set(complete) == {"outer", "inner"}
+        outer, inner = complete["outer"], complete["inner"]
+        for e in (outer, inner):
+            assert {"ts", "dur", "pid", "tid"} <= set(e)
+        # Nesting: inner lies within outer on the same track.
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+        assert outer["args"] == {"phase": 1}
+        (instant,) = [e for e in events if e["ph"] == "i"]
+        assert instant["args"] == {"guard": "clean"}
+        # Metadata names the process for Perfetto's track grouping.
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in events)
+
+    def test_exception_annotates_span(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        tracer = SpanTracer()
+        tracer.start(str(path))
+        with pytest.raises(RuntimeError):
+            with tracer.span("failing"):
+                raise RuntimeError("boom")
+        tracer.close()
+        (event,) = [
+            json.loads(l) for l in path.read_text().splitlines()
+            if json.loads(l)["ph"] == "X"
+        ]
+        assert event["args"]["error"] == "RuntimeError"
+
+    def test_inactive_tracer_returns_shared_noop(self):
+        tracer = SpanTracer()
+        assert tracer.span("a") is tracer.span("b") is _NOOP_SPAN
+        tracer.instant("nothing")  # must not raise
+
+
+class TestDisabledOverhead:
+    """The hot-path guard: telemetry off must mean no-op AND no per-call
+    allocation — the contract that lets heartbeat()/inc() sit on timing
+    paths unconditionally."""
+
+    def test_no_per_call_allocation_when_disabled(self):
+        reg = MetricsRegistry()  # disabled
+        c = reg.counter("c_total")
+        child = reg.counter("l_total", labels=("a",)).labels(a="x")
+        g = reg.gauge("g")
+        h = reg.histogram("h_seconds")
+        tracer = SpanTracer()  # inactive
+
+        def hot_path():
+            c.inc()
+            child.inc(3)
+            g.set(2.0)
+            h.observe(0.5)
+            with tracer.span("phase"):
+                pass
+            tracer.instant("event")
+
+        hot_path()  # warm any lazy caches before measuring
+        tracemalloc.start()
+        try:
+            base = tracemalloc.get_traced_memory()[0]
+            for _ in range(5000):
+                hot_path()
+            grown = tracemalloc.get_traced_memory()[0] - base
+        finally:
+            tracemalloc.stop()
+        # Zero net allocation modulo interpreter noise: 5000 iterations
+        # with even ONE surviving allocation each would grow tens of KB.
+        assert grown < 4096, f"disabled hot path allocated {grown} B"
+        assert c.value() == 0 and child.value() == 0
+
+    def test_instrumented_modules_keep_registry_disabled_by_default(self):
+        # Importing instrumented layers must register metrics without
+        # enabling anything (telemetry is opt-in per run).
+        import tree_attention_tpu.host_runtime  # noqa: F401
+        import tree_attention_tpu.utils.profiling  # noqa: F401
+        from tree_attention_tpu.obs import REGISTRY, TRACER
+
+        assert not REGISTRY.enabled
+        assert not TRACER.active
+        assert REGISTRY.get("heartbeat_ticks_total") is not None
+        assert REGISTRY.get("timing_guard_verdicts_total") is not None
+
+    def test_heartbeat_disabled_records_nothing(self):
+        from tree_attention_tpu.host_runtime import heartbeat
+        from tree_attention_tpu.obs import REGISTRY
+
+        ticks = REGISTRY.get("heartbeat_ticks_total")
+        before = ticks.value()
+        was_enabled = REGISTRY.enabled
+        REGISTRY.disable()
+        try:
+            heartbeat()
+        finally:
+            if was_enabled:
+                REGISTRY.enable()
+        assert ticks.value() == before
+
+
+@pytest.mark.parametrize("mesh", [True])
+def test_cli_decode_emits_telemetry(tmp_path, mesh):
+    """Integration (ISSUE 1 acceptance): a CPU decode run with
+    --metrics-out + --trace-events produces (a) a metrics JSON with
+    nonzero decode-token and collective-payload counters and (b) a
+    Chrome-trace JSONL that json.loads cleanly per line."""
+    metrics = tmp_path / "metrics.json"
+    trace = tmp_path / "trace.jsonl"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the CLI sets its own virtual-device flags
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tree_attention_tpu",
+         "--device", "cpu", "--n-virtual-cpu", "8", "--mesh", "seq=8",
+         "--seq-len", "256", "--heads", "2", "--head-dim", "16",
+         "--dtype", "float32", "--impl", "blockwise", "--block-size", "32",
+         "--causal", "--iters", "2", "--warmup", "1",
+         "--metrics-out", str(metrics), "--trace-events", str(trace)],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    data = json.loads(metrics.read_text())
+    by_name = {m["name"]: m for m in data["metrics"]}
+
+    def total(name, **labels):
+        return sum(
+            s["value"] for s in by_name[name]["samples"]
+            if all(s["labels"].get(k) == v for k, v in labels.items())
+        )
+
+    # (a) nonzero decode-token and collective-payload counters.
+    assert total("decode_tokens_total") > 0
+    assert total("decode_kv_tokens_total") > 0
+    assert total("decode_steps_total") > 0
+    assert total("collective_payload_bytes_total", algorithm="tree_decode") > 0
+    assert total("parallel_dispatch_total", algorithm="tree_decode") > 0
+    # The hygiene guards filed a verdict for the run.
+    assert total("timing_guard_verdicts_total") > 0
+
+    # (b) every trace line parses; the run produced real spans with the
+    # process-index pid contract.
+    events = [json.loads(line) for line in trace.read_text().splitlines()]
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert complete, "no complete spans in the trace"
+    names = {e["name"] for e in complete}
+    assert "mode:decode" in names and "time_fn" in names
+    assert all(e["pid"] == 0 for e in complete)
+    assert all(
+        isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        and e["dur"] >= 0 for e in complete
+    )

@@ -29,6 +29,7 @@ sys.path.insert(0, ".")
 from tree_attention_tpu.bench.ici import BF16_PEAK, HBM_BW  # noqa: E402
 from tree_attention_tpu.utils.profiling import (  # noqa: E402
     deflation_suspect,
+    record_guard_verdict,
     slope_per_step,
 )
 
@@ -58,6 +59,12 @@ def _per_step(step, q, k, v, ns, nl, min_seconds):
     )
     ok = [sl for sl in s.slopes if sl >= min_seconds]
     if not ok:
+        # The TOTAL fault must file its verdict too — raising without one
+        # would make the worst windows look cleanest in the guard audit.
+        record_guard_verdict(
+            "tune_sweep", "floor",
+            f"every cycle below the physical floor {min_seconds:.2e}s",
+        )
         raise RuntimeError(
             f"every cycle slope below the physical floor {min_seconds:.2e}s "
             f"({[f'{sl:.2e}' for sl in s.slopes]}): transport fault"
@@ -68,21 +75,34 @@ def _per_step(step, q, k, v, ns, nl, min_seconds):
         s, per_step=per, slopes=tuple(ok),
         spread_pct=spread,
     )
-    suspect = deflation_suspect(screened)
-    if suspect is None and len(ok) < len(s.slopes):
+    dropped = len(s.slopes) - len(ok)
+    deflated = deflation_suspect(screened)
+    suspect = deflated
+    if suspect is None and dropped:
         # Any floor-dropped cycle is hard evidence the window was faulty
         # (same invariant as profiling.deflation_suspect's non-positive
         # rule): the survivors — however clean they look — are data from
         # that same window, so the cell must not publish as clean.
         suspect = (
-            f"{len(s.slopes) - len(ok)} of {len(s.slopes)} cycles below "
+            f"{dropped} of {len(s.slopes)} cycles below "
             "the physical floor: faulty transport window; re-measure "
             "before trusting this cell"
         )
     # Publish the RAW cycles (incl. floor-dropped ones): a suspect cell
     # whose impossible readings were elided would carry no evidence of how
-    # severe the fault was.
-    return per, spread, len(s.slopes) - len(ok), suspect, s.slopes
+    # severe the fault was. Both guards file independently — a floor trip
+    # must not mask the deflation verdict (the same one-guard-masks-
+    # another shape bench.py's _train_record fix removes).
+    if dropped:
+        record_guard_verdict(
+            "tune_sweep", "floor",
+            f"{dropped} of {len(s.slopes)} cycles below the physical floor",
+        )
+    if deflated:
+        record_guard_verdict("tune_sweep", "deflation", deflated)
+    if not dropped and not deflated:
+        record_guard_verdict("tune_sweep", "clean")
+    return per, spread, dropped, suspect, s.slopes
 
 
 
@@ -96,11 +116,19 @@ def _qkv(H, Hkv, Tq, T, D=128):
 
 
 def _chain(step, n):
+    # The chain returns a SCALAR reduction of its carry, not the carry
+    # itself: slope_per_step's fetch fence copies the chain's result to
+    # host, and fetching the full (1, H, T, D) tensor (~64 MB at the 16k
+    # training shapes) per timing call is exactly the heavy-tailed RPC
+    # jitter the hardened protocol exists to cancel — and can spuriously
+    # trip the floor/deflation screens (ADVICE r5). Same contract as
+    # profiling.chain_slope, which this mirrors with sweep-local knobs.
     def f(q, k, v):
         def body(qc, _):
             return step(qc, k, v).astype(qc.dtype), None
 
-        return lax.scan(body, q, None, length=n)[0]
+        out = lax.scan(body, q, None, length=n)[0]
+        return jnp.sum(out.astype(jnp.float32))
 
     return jax.jit(f)
 
@@ -201,6 +229,14 @@ def sweep_fwd(bwd=False):
 
 
 if __name__ == "__main__":
+    from tree_attention_tpu import obs
+
+    # Env-armed like bench.py (TA_METRICS_OUT / TA_TRACE_EVENTS): without
+    # this the guard verdicts filed above would hit a disabled registry.
+    obs.configure()
     mode = sys.argv[1] if len(sys.argv) > 1 else "decode"
-    {"decode": sweep_decode, "fwd": sweep_fwd,
-     "bwd": lambda: sweep_fwd(bwd=True)}[mode]()
+    try:
+        {"decode": sweep_decode, "fwd": sweep_fwd,
+         "bwd": lambda: sweep_fwd(bwd=True)}[mode]()
+    finally:
+        obs.shutdown()

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.data import make_qkv, make_qkv_sharded
 from tree_attention_tpu.ops import flash_attention
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ, prune_axes
@@ -35,7 +36,12 @@ from tree_attention_tpu.parallel.tree import (
 from tree_attention_tpu.parallel.ulysses import ulysses_attention, ulysses_decode
 from tree_attention_tpu.utils.config import RunConfig
 from tree_attention_tpu.utils.logging import get_logger
-from tree_attention_tpu.utils.profiling import TimingStats, device_memory_stats, time_fn
+from tree_attention_tpu.utils.profiling import (
+    TimingStats,
+    device_memory_stats,
+    record_guard_verdict,
+    time_fn,
+)
 
 log = get_logger("bench")
 
@@ -55,6 +61,25 @@ PHYSICAL_FLOOR_BW = 2 * V5E_HBM_BW
 # was contended (tunnel RPC jitter is additive and heavy-tailed): the
 # symmetric, too-SLOW counterpart of the floor guard (VERDICT r4 item 1).
 JITTER_MEDIAN_OVER_MIN = 1.5
+
+# Execution-true work accounting: these count what the host loop actually
+# ran (fenced iterations × the workload's static shape), complementing the
+# trace-time dispatch counters in ops/ and parallel/.
+_DECODE_STEPS = obs.counter(
+    "decode_steps_total",
+    "fenced decode steps executed by the bench/CLI host loop",
+    labels=("name",),
+)
+_DECODE_TOKENS = obs.counter(
+    "decode_tokens_total",
+    "query tokens decoded by executed steps (batch x q_len per step)",
+    labels=("name",),
+)
+_DECODE_KV_TOKENS = obs.counter(
+    "decode_kv_tokens_total",
+    "KV tokens scanned by executed steps (seq_len per step)",
+    labels=("name",),
+)
 
 
 @dataclasses.dataclass
@@ -211,7 +236,15 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
             data_axis=axes["data"], head_axis=axes["model"],
         )[0])
 
-    stats = time_fn(fn, q, k, v, iters=cfg.iters, warmup=cfg.warmup)
+    with obs.span("bench_decode", cat="bench",
+                  args=None if not obs.TRACER.active else
+                  {"name": name, "ctx": cfg.seq_len, "iters": cfg.iters}):
+        stats = time_fn(fn, q, k, v, iters=cfg.iters, warmup=cfg.warmup)
+    if obs.REGISTRY.enabled:
+        steps = cfg.iters + max(cfg.warmup, 0)
+        _DECODE_STEPS.labels(name=name).inc(steps)
+        _DECODE_TOKENS.labels(name=name).inc(cfg.batch * cfg.q_len * steps)
+        _DECODE_KV_TOKENS.labels(name=name).inc(cfg.seq_len * steps)
     flops = attention_flops(
         batch=cfg.batch, heads=cfg.heads, q_len=cfg.q_len, kv_len=cfg.seq_len,
         head_dim=cfg.head_dim, causal=cfg.causal,
@@ -242,6 +275,7 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
         )
         log.warning("decode timing below the physical HBM floor: %s",
                     suspect["timing_suspect"])
+        record_guard_verdict(name, "floor", suspect["timing_suspect"])
     elif (
         stats.iters >= 3
         and stats.median > JITTER_MEDIAN_OVER_MIN * stats.minimum
@@ -258,6 +292,16 @@ def bench_decode(cfg: RunConfig, mesh: Optional[Mesh] = None) -> BenchResult:
         )
         log.warning("decode timing window jittery: %s",
                     suspect["timing_suspect"])
+        record_guard_verdict(name, "jitter", suspect["timing_suspect"])
+    else:
+        # "clean" = every screen that could run passed; with < 3 repeats
+        # the jitter screen cannot run, and the verdict says so rather
+        # than overclaiming.
+        record_guard_verdict(
+            name, "clean",
+            None if stats.iters >= 3 else
+            "floor screen only (jitter screen needs >= 3 repeats)",
+        )
     return BenchResult(
         name=name,
         workload=workload,
@@ -366,10 +410,13 @@ def bench_train_attention(
             return jax.jit(f)
 
         iters = max(cfg.iters, 3)
-        per, _, _ = time_per_step(
-            mk, q, k, v, n_small=n_small, n_large=n_large,
-            iters=iters, warmup=max(cfg.warmup, 1), stat="min",
-        )
+        with obs.span("bench_train_attention", cat="bench",
+                      args=None if not obs.TRACER.active else
+                      {"algorithm": algorithm, "seq": cfg.seq_len}):
+            per, _, _ = time_per_step(
+                mk, q, k, v, n_small=n_small, n_large=n_large,
+                iters=iters, warmup=max(cfg.warmup, 1), stat="min",
+            )
         stats = TimingStats(
             median=per, mean=per, minimum=per, maximum=per,
             iters=iters, times=(per,),
@@ -378,9 +425,12 @@ def bench_train_attention(
                     "chain": [n_small, n_large]}
     else:
         iters = max(cfg.iters, 8)
-        stats = time_fn(
-            jax.jit(step), q, k, v, iters=iters, warmup=max(cfg.warmup, 1)
-        )
+        with obs.span("bench_train_attention", cat="bench",
+                      args=None if not obs.TRACER.active else
+                      {"algorithm": algorithm, "seq": cfg.seq_len}):
+            stats = time_fn(
+                jax.jit(step), q, k, v, iters=iters, warmup=max(cfg.warmup, 1)
+            )
         per = stats.minimum
         protocol = {"timing_protocol": "single_step_min"}
     flops = attention_flops(
@@ -519,11 +569,17 @@ def bench_decode_compare(cfg: RunConfig, mesh: Mesh) -> Dict[str, Any]:
 
             return jax.jit(f)
 
-        per, _, _ = time_per_step(
-            mk, q, k, v, n_small=2, n_large=max(6, cfg.iters),
-            iters=max(cfg.iters, 3), warmup=max(cfg.warmup, 1), stat="min",
-        )
-        comm = collective_stats(step, q, k, v)
+        with obs.span("decode_comparator", cat="bench",
+                      args=None if not obs.TRACER.active else
+                      {"algorithm": name, "ctx": cfg.seq_len}):
+            per, _, _ = time_per_step(
+                mk, q, k, v, n_small=2, n_large=max(6, cfg.iters),
+                iters=max(cfg.iters, 3), warmup=max(cfg.warmup, 1), stat="min",
+            )
+            with obs.span("collective_stats", cat="bench",
+                          args=None if not obs.TRACER.active else
+                          {"algorithm": name}):
+                comm = collective_stats(step, q, k, v)
         assert_loop_free(comm, f"{name}_decode")
         per_step[name] = per
         record[name] = {

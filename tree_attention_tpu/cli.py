@@ -26,10 +26,23 @@ import os
 import sys
 from typing import Optional
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.utils.config import RunConfig, parse_args
 from tree_attention_tpu.utils.logging import get_logger, setup_logging
 
 log = get_logger("cli")
+
+# Execution-true host-loop totals (the train/generate loops run eagerly on
+# the host; each counted unit is real work the process finished).
+_TRAIN_STEPS = obs.counter(
+    "train_steps_total", "optimizer steps completed by the CLI train loop"
+)
+_TRAIN_TOKENS = obs.counter(
+    "train_tokens_total", "tokens consumed by completed train steps"
+)
+_GENERATED_TOKENS = obs.counter(
+    "generated_tokens_total", "tokens sampled by the CLI generate mode"
+)
 
 
 def _pick_free_port() -> int:
@@ -346,6 +359,8 @@ def _run_train(cfg: RunConfig, mesh) -> int:
             state, loss = step(state, batch)
             losses.append(float(loss))
             heartbeat()  # after the fetch: real per-step progress, not dispatch
+            _TRAIN_STEPS.inc()
+            _TRAIN_TOKENS.inc(cfg.batch * cfg.seq_len)
             log.info("step %d: loss %.4f", i, losses[-1])
             if ckpt is not None:
                 saved_last = ckpt.save(i, state, cfg=tcfg)
@@ -423,6 +438,7 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
     )
     toks = jax.block_until_ready(toks)
     heartbeat()
+    _GENERATED_TOKENS.inc(cfg.batch * n_new)
     log.info(
         "generated %s tokens from a %s prompt%s",
         toks.shape, prompt.shape,
@@ -448,29 +464,54 @@ def main(argv: Optional[list] = None) -> int:
         log_file=log_file,
         all_processes=cfg.all_processes,
     )
-    if cfg.launch > 1:
-        return _relaunch(cfg, argv)
-    _configure_backend(cfg)
+    try:
+        if cfg.launch > 1:
+            # The parent records launcher metrics; children re-run main()
+            # with the same flags and rank-suffix their own sinks.
+            obs.configure(
+                metrics_out=cfg.metrics_out, trace_events=cfg.trace_events
+            )
+            return _relaunch(cfg, argv)
+        _configure_backend(cfg)
 
-    import jax
+        import jax
 
-    from tree_attention_tpu.parallel.mesh import initialize_distributed
-    from tree_attention_tpu.utils.profiling import trace
+        from tree_attention_tpu.parallel.mesh import initialize_distributed
+        from tree_attention_tpu.utils.profiling import trace
 
-    initialize_distributed()
-    log.info(
-        "backend=%s devices=%d mesh=%s mode=%s",
-        jax.default_backend(), jax.device_count(), cfg.mesh or "none", cfg.mode,
-    )
-    mesh = _build_mesh(cfg)
-    runner = {
-        "decode": _run_decode,
-        "train": _run_train,
-        "generate": _run_generate,
-        "bench": _run_bench,
-    }[cfg.mode]
-    with trace(cfg.profile_dir):
-        return runner(cfg, mesh)
+        initialize_distributed()
+        # Telemetry arms AFTER distributed init so the tracer's pid and the
+        # metrics path's rank suffix see the real process index — on
+        # auto-detected multi-host runs neither TA_COORDINATOR nor
+        # JAX_PROCESS_INDEX exists in the environment.
+        obs.configure(
+            metrics_out=cfg.metrics_out, trace_events=cfg.trace_events
+        )
+        log.info(
+            "backend=%s devices=%d mesh=%s mode=%s",
+            jax.default_backend(), jax.device_count(), cfg.mesh or "none",
+            cfg.mode,
+        )
+        mesh = _build_mesh(cfg)
+        runner = {
+            "decode": _run_decode,
+            "train": _run_train,
+            "generate": _run_generate,
+            "bench": _run_bench,
+        }[cfg.mode]
+        with trace(cfg.profile_dir), obs.span(
+            f"mode:{cfg.mode}",
+            args=None if not obs.TRACER.active else {"mesh": cfg.mesh},
+        ):
+            return runner(cfg, mesh)
+    finally:
+        sinks = obs.shutdown()
+        if sinks["metrics_out"] or sinks["trace_events"]:
+            # The exit snapshot contract of --metrics-out / --trace-events.
+            log.info(
+                "telemetry: metrics=%s trace=%s",
+                sinks["metrics_out"] or "-", sinks["trace_events"] or "-",
+            )
 
 
 if __name__ == "__main__":

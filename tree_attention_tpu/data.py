@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tree_attention_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
 from tree_attention_tpu.utils.config import RunConfig
 
-shard_map = jax.shard_map
+from tree_attention_tpu.parallel.compat import shard_map
 
 # Single source of truth for the canonical (reference) workload defaults.
 _REF = RunConfig()

@@ -29,9 +29,57 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.utils.logging import get_logger
 
 log = get_logger("host_runtime")
+
+# Launcher/watchdog observability (all host-side, execution-true — nothing
+# here is ever traced by JAX). Exit statuses are classified with the same
+# conventions the supervisor reports: 124 deadline, 125 heartbeat stall,
+# 128+sig crash-by-signal.
+_HEARTBEATS = obs.counter(
+    "heartbeat_ticks_total",
+    "host-visible progress marks (one per train step / fenced timing "
+    "iteration)",
+)
+_GANG_LAUNCHES = obs.counter(
+    "gang_launches_total", "launch_local invocations"
+)
+_GANG_ATTEMPTS = obs.counter(
+    "gang_attempts_total",
+    "gang launch attempts, including elastic relaunches",
+)
+_RANK_EXITS = obs.counter(
+    "rank_exits_total",
+    "child rank exits by outcome (ok / crash / deadline / stall)",
+    labels=("outcome",),
+)
+_WATCHDOG_STALLS = obs.counter(
+    "watchdog_stalls_total",
+    "heartbeat watchdog firings (whole-gang kills, status 125)",
+)
+
+
+def _rank_exit_outcome(status: int) -> str:
+    if status == 0:
+        return "ok"
+    if status == 124:
+        return "deadline"
+    if status == 125:
+        return "stall"
+    return "crash"
+
+
+def _account_gang_result(statuses: Sequence[int]) -> None:
+    if not obs.REGISTRY.enabled:
+        return
+    for s in statuses:
+        _RANK_EXITS.labels(outcome=_rank_exit_outcome(s)).inc()
+    if any(s == 125 for s in statuses):
+        _WATCHDOG_STALLS.inc()
+        obs.instant("watchdog_stall", cat="launcher",
+                    args={"statuses": list(statuses)})
 
 # The native sources ship inside the package (``tree_attention_tpu/native``
 # is package data, pyproject ``[tool.setuptools.package-data]``) so an
@@ -479,6 +527,7 @@ def heartbeat() -> None:
     Touching the file is the whole protocol: the supervisor compares its
     mtime against the stall window.
     """
+    _HEARTBEATS.inc()  # one flag check when telemetry is off
     path = os.environ.get("TA_HEARTBEAT_FILE")
     if not path:
         return
@@ -562,6 +611,9 @@ def maybe_inject_fault(step: int) -> None:
         except FileNotFoundError:
             return  # already fired on a previous attempt
     log.error("fault injection: rank %d exiting at step %d", rank, step)
+    obs.instant("fault_injection", cat="launcher",
+                args={"rank": rank, "step": step})
+    obs.TRACER.flush()  # os._exit skips atexit; don't lose the event
     os._exit(86)
 
 
@@ -630,11 +682,20 @@ def launch_local(
     if heartbeat_stall is not None:
         hb_dir = tempfile.mkdtemp(prefix="ta_hb_")
     _LAST_LAUNCH["attempts"] = 1
+    _GANG_LAUNCHES.inc()
     try:
-        return _launch_elastic(
-            argv, nprocs, timeout, grace, failfast, heartbeat_stall, hb_dir,
-            restarts,
-        )
+        with obs.span("launch_local", cat="launcher",
+                      args=None if not obs.TRACER.active else
+                      {"nprocs": nprocs, "restarts": restarts,
+                       "watched": heartbeat_stall is not None}):
+            failures, statuses = _launch_elastic(
+                argv, nprocs, timeout, grace, failfast, heartbeat_stall,
+                hb_dir, restarts,
+            )
+        if obs.REGISTRY.enabled:
+            _GANG_ATTEMPTS.inc(_LAST_LAUNCH["attempts"])
+            _account_gang_result(statuses)
+        return failures, statuses
     finally:
         if hb_dir is not None:
             shutil.rmtree(hb_dir, ignore_errors=True)
@@ -700,6 +761,15 @@ def _launch_elastic(
             "gang attempt %d/%d failed (statuses %s); restarting",
             attempt, restarts + 1, statuses,
         )
+        obs.instant("gang_attempt_failed", cat="launcher",
+                    args={"attempt": attempt, "statuses": list(statuses)})
+        # Retried attempts' exits must land in the counters too — the
+        # caller only accounts the FINAL attempt's statuses, and a stall
+        # that elastic recovery papered over is exactly what
+        # watchdog_stalls_total exists to surface. (The native C++
+        # elastic path runs its retry loop opaquely; its intermediate
+        # statuses never reach Python and stay uncounted.)
+        _account_gang_result(statuses)
     raise AssertionError("unreachable")
 
 

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.models.transformer import (
     Params,
     TransformerConfig,
@@ -45,6 +46,29 @@ from tree_attention_tpu.models.transformer import (
     _mlp_block,
     rms_norm,
     rope,
+)
+
+# Cache observability. forward_step is normally jitted (generate() scans
+# it), so these count traces/dispatches; the capacity gauge is a point
+# value either way. Execution-true generated-token totals live in the CLI
+# generate loop.
+_CACHE_CAPACITY = obs.gauge(
+    "kv_cache_capacity_tokens",
+    "capacity of the most recently allocated KV cache (tokens)",
+)
+_CACHE_ALLOCS = obs.counter(
+    "kv_cache_allocs_total",
+    "KV cache allocations",
+    labels=("sharded",),
+)
+_STEP_DISPATCH = obs.counter(
+    "forward_step_dispatch_total",
+    "forward_step dispatches by cache kind (trace-time under jit)",
+    labels=("cache",),
+)
+_CACHE_QUANTIZE = obs.counter(
+    "kv_cache_quantize_total",
+    "whole-cache int8 quantizations (quantize-after-prefill)",
 )
 from tree_attention_tpu.ops.decode import flash_decode
 from tree_attention_tpu.parallel.mesh import (
@@ -117,6 +141,7 @@ def quantize_cache(cache: KVCache) -> QuantKVCache:
 
     from tree_attention_tpu.ops.pallas_decode import quantize_symmetric_int8
 
+    _CACHE_QUANTIZE.inc()
     k_q, k_s = quantize_symmetric_int8(cache.k, axis=3)  # over tokens
     v_q, v_s = quantize_symmetric_int8(cache.v, axis=3)
     return QuantKVCache(
@@ -162,6 +187,9 @@ def init_cache(
     else:
         k = jnp.zeros(shape, cfg.dtype)
         v = jnp.zeros(shape, cfg.dtype)
+    if obs.REGISTRY.enabled:
+        _CACHE_CAPACITY.set(max_len)
+        _CACHE_ALLOCS.labels(sharded=str(mesh is not None).lower()).inc()
     return KVCache(k=k, v=v, length=jnp.zeros((), jnp.int32))
 
 
@@ -213,6 +241,8 @@ def forward_step(
 
     x = jnp.take(params["embed"], tokens, axis=0)
     quant = isinstance(cache, QuantKVCache)
+    if obs.REGISTRY.enabled:
+        _STEP_DISPATCH.labels(cache="quant" if quant else "exact").inc()
 
     def body(x, layer_and_cache):
         if quant:

@@ -1,0 +1,131 @@
+"""Telemetry: the process-wide metrics registry and host-side span tracer.
+
+Two complementary instruments, both off (and free) by default:
+
+- :mod:`~tree_attention_tpu.obs.metrics` — thread-safe counters / gauges /
+  fixed-bucket histograms with labels; exportable as JSON
+  (``--metrics-out``) and Prometheus text format.
+- :mod:`~tree_attention_tpu.obs.tracing` — span tracer emitting
+  Chrome-trace-format JSONL (``--trace-events``), loadable in Perfetto
+  alongside ``jax.profiler`` device traces; ``pid`` is the JAX process
+  index so multi-host captures merge cleanly.
+
+Lifecycle: the CLI (or any embedder) calls :func:`configure` once at
+startup and :func:`shutdown` at exit; instrumentation sites declare their
+metrics at import via :func:`counter` / :func:`gauge` / :func:`histogram`
+and record unconditionally — the disabled path is a single flag check.
+
+Environment fallbacks ``TA_METRICS_OUT`` / ``TA_TRACE_EVENTS`` let
+subprocesses a run spawns (``--launch`` ranks) inherit telemetry without
+plumbing flags; explicit arguments win, and spawners whose children have
+no rank contract strip the vars instead (``bench.py``'s comparator
+subprocesses — an unsuffixed child would clobber the parent's sinks).
+Multi-process runs rank-suffix BOTH sink paths (each process owns its
+files — the tracer truncates on open, so ranks must never share a path);
+trace events additionally carry the rank as ``pid`` so the per-rank files
+merge into one Perfetto timeline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from tree_attention_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+)
+from tree_attention_tpu.obs.tracing import (  # noqa: F401
+    SpanTracer,
+    TRACER,
+    instant,
+    span,
+    traced,
+)
+
+_STATE: Dict[str, Optional[str]] = {"metrics_out": None}
+
+
+def enabled() -> bool:
+    """True when the metrics registry records (the tracer has its own
+    ``TRACER.active`` — either instrument can run alone)."""
+    return REGISTRY.enabled
+
+
+def enable() -> None:
+    REGISTRY.enable()
+
+
+def disable() -> None:
+    REGISTRY.disable()
+
+
+def _rank_suffixed(path: str) -> str:
+    """Each process of a multi-process run owns its own metrics file —
+    same convention as the CLI's rank-suffixed ``--log-file``. Detects
+    both the local launcher's env contract (``TA_COORDINATOR``) and an
+    already-initialized multi-host JAX runtime (metadata-server
+    auto-detect), so N hosts on a shared filesystem never clobber one
+    path; callers should configure *after* distributed init (the CLI
+    does)."""
+    from tree_attention_tpu.utils.logging import _process_count, _process_index
+
+    if os.environ.get("TA_COORDINATOR") or _process_count() > 1:
+        return f"{path}.p{_process_index()}"
+    return path
+
+
+def configure(
+    metrics_out: Optional[str] = None,
+    trace_events: Optional[str] = None,
+) -> None:
+    """Arm telemetry for this process.
+
+    ``metrics_out``: path the exit snapshot (JSON) is written to by
+    :func:`shutdown`; enables the registry. ``trace_events``: Chrome-trace
+    JSONL sink path; starts the span tracer. ``None`` falls back to
+    ``TA_METRICS_OUT`` / ``TA_TRACE_EVENTS`` so child processes inherit
+    the parent's telemetry choice.
+    """
+    metrics_out = metrics_out or os.environ.get("TA_METRICS_OUT")
+    trace_events = trace_events or os.environ.get("TA_TRACE_EVENTS")
+    if metrics_out:
+        _STATE["metrics_out"] = _rank_suffixed(metrics_out)
+        REGISTRY.enable()
+    if trace_events:
+        TRACER.start(_rank_suffixed(trace_events))
+        # Spans without counters are half a story (and vice versa): one
+        # flag arms both; --metrics-out alone still skips the JSON dump.
+        REGISTRY.enable()
+
+
+def shutdown() -> Dict[str, Any]:
+    """Flush sinks: write the metrics snapshot (if configured), close the
+    tracer, and DISARM — a later run in the same process records nothing
+    (and rewrites no earlier run's file) unless it calls :func:`configure`
+    again. Metric values persist across configure cycles (process-lifetime
+    totals); only the sinks and the enabled flag reset. Idempotent.
+    Returns ``{"metrics_out": path-or-None, "trace_events": path-or-None}``
+    — the sinks THIS run actually wrote — for the caller's exit log line."""
+    out: Dict[str, Any] = {
+        "metrics_out": None,
+        "trace_events": TRACER.path if TRACER.active else None,
+    }
+    path = _STATE["metrics_out"]
+    if path and REGISTRY.enabled:
+        try:
+            REGISTRY.write_json(path)
+            out["metrics_out"] = path
+        except OSError:
+            pass  # never let observability fail the run at exit
+    _STATE["metrics_out"] = None
+    REGISTRY.disable()
+    TRACER.close()
+    return out

@@ -28,8 +28,32 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.ops.block_utils import pad_to_block
 from tree_attention_tpu.ops.reference import attention_blockwise, merge_partials
+
+# Dispatch accounting (trace-time under an enclosing jit — see
+# obs.metrics): which decode path served the call, and how many KV/query
+# tokens one executed step of it scans/produces. Execution-true token
+# totals live in the host loops (bench/harness.py, cli.py).
+_DECODE_DISPATCH = obs.counter(
+    "decode_dispatch_total",
+    "flash_decode dispatches by kernel path (trace-time under jit)",
+    labels=("path",),
+)
+_DECODE_KV_TOKENS = obs.counter(
+    "decode_dispatch_kv_tokens_total",
+    "KV tokens one executed step of each dispatched decode call scans "
+    "(trace-time under jit)",
+    labels=("path",),
+)
+
+
+def _account_dispatch(path: str, kv_tokens: int) -> None:
+    if not obs.REGISTRY.enabled:
+        return
+    _DECODE_DISPATCH.labels(path=path).inc()
+    _DECODE_KV_TOKENS.labels(path=path).inc(int(kv_tokens))
 
 
 def default_num_splits(kv_len: int, block_size: int) -> int:
@@ -123,6 +147,7 @@ def flash_decode(
             )
 
             kernel = attention_pallas_fwd
+        _account_dispatch(impl, Tk)
         return kernel(
             q, k, v, causal=True, scale=scale,
             q_offset=q_position, kv_offset=0, block_size=bk,
@@ -150,5 +175,6 @@ def flash_decode(
             block_size=min(block_size, chunk),
         )
 
+    _account_dispatch("chunked_vmap", Tk)
     outs, lses = jax.vmap(one_chunk)(kb, vb, offsets)
     return merge_partials(outs, lses)

@@ -40,6 +40,7 @@ from tree_attention_tpu.ops.block_utils import (
     matmul_precision,
     static_offsets,
     tile_live,
+    tpu_compiler_params,
 )
 
 
@@ -295,7 +296,7 @@ def _attention_pallas_fwd(
         # sequential (scratch carries the online-softmax state across it).
         # Declaring that lets Mosaic split the parallel dims across cores on
         # megacore parts (v5p/v4); no-op on single-core chips (v5e).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -47,6 +47,7 @@ from tree_attention_tpu.ops.block_utils import (
     LANES as _LANES,
     NEG_INF,
     matmul_precision,
+    tpu_compiler_params,
 )
 
 
@@ -292,7 +293,7 @@ def _attention_bwd_pallas(
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         # dq accumulates across the (sequential) KV dim; the rest are
         # independent — see the fwd kernel's note on megacore splitting.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -334,7 +335,7 @@ def _attention_bwd_pallas(
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         # dk/dv accumulate across the (sequential) grouped-Q dim.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -41,11 +41,24 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.ops.block_utils import (
     LANES as _LANES,
     NEG_INF,
     matmul_precision,
     pad_to_block as _pad_dim,
+    tpu_compiler_params,
+)
+
+# The wrappers below are jitted, so their Python bodies run once per
+# distinct (shape, config): this counts kernel program BUILDS — a
+# recompile storm (e.g. a caller advancing a static q_position per token)
+# shows up here as a runaway count. Execution totals live in the host
+# loops (bench/harness.py, cli.py).
+_KERNEL_BUILDS = obs.counter(
+    "pallas_decode_kernel_builds_total",
+    "flash-decode kernel program builds (one per distinct shape/config)",
+    labels=("kernel",),
 )
 
 
@@ -474,6 +487,8 @@ def attention_pallas_decode_q8q(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
     ).reshape(2, 1)
 
+    if obs.REGISTRY.enabled:
+        _KERNEL_BUILDS.labels(kernel="q8q").inc()
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_decode_q8q_kernel,
@@ -500,7 +515,7 @@ def attention_pallas_decode_q8q(
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -597,6 +612,12 @@ def attention_pallas_decode(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
     ).reshape(2, 1)
 
+    if obs.REGISTRY.enabled:
+        # int8 operands here are the q8 (bf16-cast) path riding the base
+        # kernel; the q8q wrapper has its own pallas_call and label.
+        _KERNEL_BUILDS.labels(
+            kernel="q8" if k.dtype == jnp.int8 else "exact"
+        ).inc()
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_decode_kernel,
@@ -624,7 +645,7 @@ def attention_pallas_decode(
         ],
         # Only the split-KV dim is sequential (carried online-softmax state);
         # batch-head and Q-tile dims can split across megacore parts.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -1,0 +1,57 @@
+"""Shared collective-payload accounting for the sequence-parallel families.
+
+One home for the telemetry vocabulary all three families (tree, ring,
+Ulysses) report in, so the algorithm modules don't reach into each other
+for counters: per-device wire bytes by collective kind, and entry-point
+dispatch counts. The per-call figures are closed forms over the dispatched
+call's static shapes — the running-total companion to ``bench/comm.py``'s
+compiled-HLO parse (which remains the per-call ground truth).
+
+Counted where each entry point's Python body runs: per call when eager,
+per trace under an enclosing jit (see :mod:`tree_attention_tpu.obs.metrics`
+on trace-time semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from tree_attention_tpu import obs
+
+PAYLOAD_BYTES = obs.counter(
+    "collective_payload_bytes_total",
+    "per-device collective operand bytes implied by dispatched calls' "
+    "static shapes (trace-time under an enclosing jit)",
+    labels=("algorithm", "collective"),
+)
+DISPATCH = obs.counter(
+    "parallel_dispatch_total",
+    "sequence-parallel entry-point dispatches (trace-time under an "
+    "enclosing jit)",
+    labels=("algorithm",),
+)
+
+
+def shard_counts(
+    mesh, data_axis: Optional[str], head_axis: Optional[str]
+) -> Tuple[int, int]:
+    """(data_shards, head_shards) for converting an entry point's GLOBAL
+    array dims to the per-device dims its collectives actually move —
+    inside ``shard_map`` the operands are already batch/head shards, so
+    per-device accounting must divide by any extra mesh axes in play."""
+
+    def size(axis: Optional[str]) -> int:
+        return mesh.shape.get(axis, 1) if axis is not None else 1
+
+    return max(size(data_axis), 1), max(size(head_axis), 1)
+
+
+def account_payload(algorithm: str, **collective_bytes: int) -> None:
+    """Record one dispatch's per-device payload bytes by collective kind."""
+    if not obs.REGISTRY.enabled:
+        return
+    DISPATCH.labels(algorithm=algorithm).inc()
+    for coll, nbytes in collective_bytes.items():
+        PAYLOAD_BYTES.labels(algorithm=algorithm, collective=coll).inc(
+            int(nbytes)
+        )

@@ -1,0 +1,24 @@
+"""JAX version compatibility for the sharding entry points.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to ``jax.shard_map``
+(and its ``check_rep`` flag was renamed ``check_vma``) across the JAX
+releases this framework spans. Every module that builds sharded programs
+imports the symbol from here so the adaptation lives in exactly one place:
+on a current JAX this is ``jax.shard_map`` untouched; on an older one the
+experimental entry is wrapped to accept the modern keyword.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-graduation JAX: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )

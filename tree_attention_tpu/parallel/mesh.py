@@ -110,19 +110,22 @@ def make_mesh(
             f"mesh axes {dict(zip(names, sizes))} need {int(np.prod(sizes))} "
             f"devices, have {n}"
         )
-    # Axis types are forced to Auto: the framework is written in GSPMD auto-
-    # sharding style (with_sharding_constraint + shard_map islands), not the
-    # sharding-in-types Explicit mode that jax.make_mesh defaults to in
-    # JAX >= 0.9.
-    auto = (jax.sharding.AxisType.Auto,) * len(names)
+    # Axis types are forced to Auto where the concept exists: the framework
+    # is written in GSPMD auto-sharding style (with_sharding_constraint +
+    # shard_map islands), not the sharding-in-types Explicit mode that
+    # jax.make_mesh defaults to in JAX >= 0.9. Older JAX predates AxisType
+    # entirely (every axis is implicitly Auto there), so the kwarg is only
+    # passed when the attribute exists.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    kw = {} if axis_type is None else {
+        "axis_types": (axis_type.Auto,) * len(names)
+    }
     if len(devices) == jax.device_count():
         # Full-device meshes go through jax.make_mesh for its ICI-topology-
         # aware device ordering; explicit subsets keep the caller's order.
-        return jax.make_mesh(
-            tuple(sizes), names, axis_types=auto, devices=tuple(devices)
-        )
+        return jax.make_mesh(tuple(sizes), names, devices=tuple(devices), **kw)
     mesh_devices = np.asarray(devices).reshape(tuple(sizes))
-    return Mesh(mesh_devices, names, axis_types=auto)
+    return Mesh(mesh_devices, names, **kw)
 
 
 def cpu_mesh(n: int, axes: Optional[Mapping[str, int]] = None) -> Mesh:

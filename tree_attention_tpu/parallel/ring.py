@@ -39,10 +39,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from tree_attention_tpu.parallel.compat import shard_map
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
 from tree_attention_tpu.ops.reference import NEG_INF, finalize_merge
+from tree_attention_tpu.parallel.accounting import (
+    account_payload as _account_payload,
+    shard_counts as _shard_counts,
+)
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
 
@@ -134,7 +139,10 @@ def ring_decode(
         check_vma=False,
     )
     def _sharded(q_l, k_l, v_l):
-        n = lax.axis_size(seq_axis)
+        # The mesh axis size is static at trace time; closing over it (vs
+        # lax.axis_size, which moved API homes across JAX versions) keeps
+        # the unrolled hop count visibly constant.
+        n = n_shards
         me = lax.axis_index(seq_axis)
         out_b, lse_b = flash_attention(
             q_l, k_l, v_l,
@@ -158,7 +166,21 @@ def ring_decode(
             m, num, den = _merge_step(m, num, den, rot_o, rot_l)
         return finalize_merge(num, den, m, q.dtype)
 
-    return _sharded(q, k, v)
+    # N−1 sequential partial rotations, each the (out, lse) pair — the
+    # O(N)-depth chain the tree merge's log-depth collectives are raced
+    # against; like tree_decode's merge, context-independent. Per-device:
+    # global batch/head dims divide over any data/model axes.
+    d_sh, h_sh = _shard_counts(mesh, data_axis, head_axis)
+    rows = -(-q.shape[0] // d_sh) * -(-q.shape[1] // h_sh) * Tq
+    hops = mesh.shape[seq_axis] - 1
+    _account_payload(
+        "ring_decode",
+        ppermute=hops * rows * (q.dtype.itemsize * q.shape[3] + 4),
+    )
+    with obs.span("ring_decode", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"ctx": Tk_global, "hops": hops}):
+        return _sharded(q, k, v)
 
 
 def ring_attention(
@@ -211,7 +233,7 @@ def ring_attention(
         check_vma=False,
     )
     def _sharded(q_l, k_l, v_l):
-        n = lax.axis_size(seq_axis)
+        n = n_shards  # static mesh axis size (see ring_decode)
         me = lax.axis_index(seq_axis)
         # Send my block to the next device; after step j I hold the KV shard
         # originally resident on device (me - j) mod n.
@@ -252,4 +274,15 @@ def ring_attention(
         m, num, den = attend(k_last, v_last, n - 1, m, num, den)
         return finalize_merge(num, den, m, q.dtype)
 
-    return _sharded(q, k, v)
+    # N−1 KV-shard rotations of the local (k, v) pair per step (per-device:
+    # batch/head dims divided over any data/model axes).
+    d_sh, h_sh = _shard_counts(mesh, data_axis, head_axis)
+    _account_payload(
+        "ring_attention",
+        ppermute=(n_shards - 1) * 2 * -(-B // d_sh) * -(-k.shape[1] // h_sh)
+        * Tk_local * D * k.dtype.itemsize,
+    )
+    with obs.span("ring_attention", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"seq": Tq_global, "hops": n_shards - 1}):
+        return _sharded(q, k, v)

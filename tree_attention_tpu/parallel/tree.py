@@ -38,8 +38,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from tree_attention_tpu.parallel.compat import shard_map
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.ops import (
     flash_attention,
     mesh_platforms,
@@ -49,6 +50,10 @@ from tree_attention_tpu.ops.reference import (
     NEG_INF,
     finalize_merge as _finalize_merge,
     merge_partials,
+)
+from tree_attention_tpu.parallel.accounting import (
+    account_payload as _account_payload,
+    shard_counts as _shard_counts,
 )
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
@@ -234,7 +239,23 @@ def _tree_decode_common(
         num, den, m = _merge_across(out, lse, seq_axis, payload)
         return _finalize_merge(num, den, m, q.dtype)
 
-    return _sharded(q, *kv_arrays, *rep_arrays)
+    # Merge wire accounting (context-independent — the tree decode merge
+    # moves O(B·H·Tq·D) regardless of Tk): one f32 pmax over the lse rows,
+    # one fused psum over [num | den] (same bytes split or packed). The
+    # operands inside shard_map are batch/head SHARDS, so per-device bytes
+    # divide the global dims by any data/model axes in play.
+    B, Hq, _, D = q.shape
+    d_sh, h_sh = _shard_counts(mesh, data_axis, head_axis)
+    lse_bytes = 4 * -(-B // d_sh) * -(-Hq // h_sh) * Tq
+    _account_payload(
+        "tree_decode",
+        pmax=lse_bytes,
+        psum=4 * -(-B // d_sh) * -(-Hq // h_sh) * Tq * D + lse_bytes,
+    )
+    with obs.span("tree_decode", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"ctx": Tk_global, "shards": n_shards, "payload": payload}):
+        return _sharded(q, *kv_arrays, *rep_arrays)
 
 
 def tree_decode(
@@ -692,4 +713,22 @@ def tree_attention(
             jnp.concatenate(lse_chunks, axis=2),
         )
 
-    return _sharded(q, k, v)
+    # Per-step wire accounting across all chunks (chunk sizes sum to
+    # Tq_local, so totals close over Tq_global regardless of n_chunks):
+    # the chunked Q all-gather, the f32 pmax over gathered-row lse, and the
+    # fused [num | den] psum_scatter (same bytes split or packed). Global
+    # batch/head dims divide down to the per-device shards the collectives
+    # actually carry.
+    d_sh, h_sh = _shard_counts(mesh, data_axis, head_axis)
+    rows = -(-B // d_sh) * -(-Hq // h_sh) * Tq_global
+    _account_payload(
+        "tree_attention",
+        all_gather=rows * D * q.dtype.itemsize,
+        pmax=4 * rows,
+        psum_scatter=4 * rows * (D + 1),
+    )
+    with obs.span("tree_attention", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"seq": Tq_global, "shards": n_shards, "layout": layout,
+                   "chunks": n_chunks}):
+        return _sharded(q, k, v)

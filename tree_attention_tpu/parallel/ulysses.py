@@ -34,9 +34,14 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from tree_attention_tpu.parallel.compat import shard_map
 
+from tree_attention_tpu import obs
 from tree_attention_tpu.ops import flash_attention, resolve_impl_for_mesh
+from tree_attention_tpu.parallel.accounting import (
+    account_payload as _account_payload,
+    shard_counts as _shard_counts,
+)
 from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
 
@@ -135,7 +140,24 @@ def ulysses_decode(
         lse = lax.all_gather(lse_h, seq_axis, axis=1, tiled=True)
         return out.astype(q.dtype), lse.astype(jax.numpy.float32)
 
-    return _sharded(q, k, v)
+    # The family's founding liability, counted: each step all-to-alls the
+    # ENTIRE local KV buffer (O(Tk/N) per device — linear in context, where
+    # tree/ring move O(B·H·Tq·D)), then gathers back the head-slice
+    # (out, lse) partials. Per-device dims: batch over the data axis, heads
+    # over the model axis (the seq axis divides KV tokens / head groups).
+    d_sh, _ = _shard_counts(mesh, data_axis, None)
+    B_l = -(-B // d_sh)
+    g = (Hq // h_shards) // n
+    _account_payload(
+        "ulysses_decode",
+        all_to_all=2 * B_l * (Hkv // h_shards) * (Tk_global // n) * D
+        * k.dtype.itemsize,
+        all_gather=B_l * g * Tq * (D * q.dtype.itemsize + 4),
+    )
+    with obs.span("ulysses_decode", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"ctx": Tk_global, "shards": n}):
+        return _sharded(q, k, v)
 
 
 def ulysses_attention(
@@ -233,4 +255,20 @@ def ulysses_attention(
         )
         return out_l.astype(q.dtype), lse_l.astype(jax.numpy.float32)
 
-    return _sharded(q, k, v)
+    # Five all-to-alls per step: Q/K/V seq→head, then (out, lse) back.
+    # Per-device dims: batch over the data axis, heads over the model axis.
+    d_sh, _ = _shard_counts(mesh, data_axis, None)
+    B_l = -(-B // d_sh)
+    itm = q.dtype.itemsize
+    _account_payload(
+        "ulysses_attention",
+        all_to_all=(
+            B_l * (Hq // h_shards) * (Tq_global // n) * D * itm      # q
+            + 2 * B_l * (Hkv // h_shards) * (Tk_global // n) * D * itm  # k, v
+            + B_l * (Hq // h_shards) * (Tq_global // n) * (D * itm + 4)  # out, lse
+        ),
+    )
+    with obs.span("ulysses_attention", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"seq": Tq_global, "shards": n}):
+        return _sharded(q, k, v)

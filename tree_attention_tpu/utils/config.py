@@ -92,6 +92,8 @@ class RunConfig:
     log_file: Optional[str] = None
     all_processes: bool = False
     profile_dir: Optional[str] = None
+    metrics_out: Optional[str] = None   # JSON metrics snapshot at exit
+    trace_events: Optional[str] = None  # Chrome-trace JSONL span sink
 
     def mesh_axes(self) -> Optional[Dict[str, int]]:
         return parse_mesh_spec(self.mesh) if self.mesh else None
@@ -224,6 +226,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="log from every host, not just process 0")
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="capture a jax.profiler trace into this directory")
+    p.add_argument("--metrics-out", default=d.metrics_out, metavar="PATH",
+                   help="write the telemetry registry (tokens decoded, "
+                        "collective payload bytes, kernel builds, guard "
+                        "verdicts, ...) as JSON at exit; under --launch "
+                        "each rank writes PATH.pK")
+    p.add_argument("--trace-events", default=d.trace_events, metavar="PATH",
+                   help="emit host-side spans as Chrome-trace-format JSONL "
+                        "(one JSON event per line; load in Perfetto "
+                        "alongside a --profile-dir device trace)")
     return p
 
 

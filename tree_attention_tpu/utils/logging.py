@@ -72,6 +72,29 @@ def _process_index() -> int:
     return 0
 
 
+def _process_count() -> int:
+    """Best-effort world size, with the same probing discipline (and the
+    same fail-open rank-0/world-1 default) as :func:`_process_index`."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None and _distributed_initialized(jax_mod):
+        try:
+            return jax_mod.process_count()
+        except Exception:
+            pass
+    env = os.environ.get("TA_NUM_PROCESSES")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if jax_mod is not None and _backend_initialized():
+        try:
+            return jax_mod.process_count()
+        except Exception:
+            pass
+    return 1
+
+
 def _distributed_initialized(jax_mod) -> bool:
     try:
         return jax_mod.distributed.is_initialized()

@@ -19,6 +19,48 @@ from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from tree_attention_tpu import obs
+
+# The measurement-hygiene guards (physical ceiling, deflation screen,
+# jitter note) file their verdicts here as well as into the records they
+# annotate, so a round's runs can be audited for guard-trip rates without
+# re-parsing every record (ISSUE 1: deflation/ceiling verdicts as
+# structured events).
+_GUARD_VERDICTS = obs.counter(
+    "timing_guard_verdicts_total",
+    "measurement-hygiene guard verdicts by guard kind",
+    labels=("record", "guard"),
+)
+
+
+def record_guard_verdict(
+    record: str, guard: str, reason: Optional[str] = None
+) -> None:
+    """Count one guard verdict and mirror it as a trace instant.
+
+    ``guard`` taxonomy (one physical fault can legitimately file under the
+    side the guard actually computed — the label says WHICH screen fired):
+
+    - ``ceiling`` — a derived rate (implied bandwidth, MFU) exceeds the
+      hardware spec: the fence did not fence (bench.py's slope records);
+    - ``floor`` — a wall-clock reading sits below the physical minimum
+      time for the workload (bench_decode's median check, tune_sweep's
+      per-cycle screen) — the time-domain dual of ``ceiling``;
+    - ``deflation`` — min cycle far below its siblings' median: the
+      transport resolved a fetch early;
+    - ``jitter`` — wide spread / median≫min: contended window, estimate
+      stands but is an upper bound;
+    - ``clean`` — every screen that ran passed (``reason`` names any
+      screen the call site could not run, e.g. jitter needs >= 3 repeats).
+    """
+    if not obs.REGISTRY.enabled:
+        return
+    _GUARD_VERDICTS.labels(record=record, guard=guard).inc()
+    args = {"record": record, "guard": guard}
+    if reason:
+        args["reason"] = reason
+    obs.instant("guard_verdict", cat="timing", args=args)
+
 
 @dataclasses.dataclass
 class TimingStats:
@@ -72,15 +114,20 @@ def time_fn(
 
     from tree_attention_tpu.host_runtime import heartbeat
 
-    for _ in range(max(warmup, 0)):
-        fence(fn(*args, **kwargs))
-        heartbeat()  # each fenced iteration is host-visible progress
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        fence(fn(*args, **kwargs))
-        times.append(time.perf_counter() - t0)
-        heartbeat()
+    # One span per time_fn call (never per iteration — the timed loop must
+    # not carry telemetry), trace-sinked only when a sink is armed.
+    with obs.span("time_fn", cat="timing",
+                  args=None if not obs.TRACER.active else
+                  {"iters": iters, "warmup": warmup, "fetch": fetch}):
+        for _ in range(max(warmup, 0)):
+            fence(fn(*args, **kwargs))
+            heartbeat()  # each fenced iteration is host-visible progress
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fence(fn(*args, **kwargs))
+            times.append(time.perf_counter() - t0)
+            heartbeat()
     return TimingStats(
         median=statistics.median(times),
         mean=statistics.fmean(times),
@@ -226,12 +273,16 @@ def slope_per_step(
         # the executables, so extra warmup runs would just spend the
         # machine's time without changing the estimator.
         w = warmup if cycle == 0 else 0
-        s_small = time_fn(
-            fn_small, *args, iters=iters, warmup=w, fetch=fetch, **kwargs
-        )
-        s_large = time_fn(
-            fn_large, *args, iters=iters, warmup=w, fetch=fetch, **kwargs
-        )
+        with obs.span("slope_cycle", cat="timing",
+                      args=None if not obs.TRACER.active else
+                      {"cycle": cycle, "n_small": n_small,
+                       "n_large": n_large}):
+            s_small = time_fn(
+                fn_small, *args, iters=iters, warmup=w, fetch=fetch, **kwargs
+            )
+            s_large = time_fn(
+                fn_large, *args, iters=iters, warmup=w, fetch=fetch, **kwargs
+            )
         slopes.append((pick(s_large) - pick(s_small)) / (n_large - n_small))
     positive = [s for s in slopes if s > 0]
     if not positive:
