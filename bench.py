@@ -598,6 +598,19 @@ def _tree_vs_ring_decode_record():
     return rec
 
 
+def _serving_record():
+    """Continuous batching vs sequential decode (ISSUE 2): the slot
+    scheduler's one-compiled-step-per-tick throughput at 8 slots against
+    one-request-at-a-time decode, slope-timed via the blessed chain_slope
+    harness plus real engine trace runs swept over slots and arrival
+    rates. A CPU proxy by design — the measured quantity is the batching
+    structure (fixed per-step cost amortised across slots), which
+    transfers; see tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving
+
+    return bench_serving()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -806,6 +819,7 @@ def _run_suite() -> None:
         _save_evidence(suite)
     run("tree_vs_ring_cpu8", _tree_vs_ring_record)
     run("tree_vs_ring_decode_cpu8", _tree_vs_ring_decode_record)
+    run("serving_continuous_batching", _serving_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -883,6 +897,17 @@ def _summarize_record(name, rec):
         for ctx, sub in rec.items():
             if isinstance(sub, dict) and "tree_speedup_vs_ring" in sub:
                 out[f"{ctx}_vs_ring"] = sub["tree_speedup_vs_ring"]
+    if name == "serving_continuous_batching":
+        slope = rec.get("slope", {})
+        if "speedup_vs_sequential" in slope:
+            out["slope_speedup_vs_sequential"] = (
+                slope["speedup_vs_sequential"]
+            )
+        trace = rec.get("trace", {})
+        if "trace_speedup_vs_sequential" in trace:
+            out["trace_speedup_vs_sequential"] = (
+                trace["trace_speedup_vs_sequential"]
+            )
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

@@ -33,6 +33,12 @@ import pytest  # noqa: E402
 # runtime they fail slowly enough to starve the tier-1 time budget that the
 # rest of the suite runs under. Skip collecting them there; on the JAX the
 # repo targets this list is empty and nothing changes.
+#
+# test_serving.py must stay OUT of this list: the ragged-batch +
+# continuous-batching suite is deliberately legacy-safe (CPU paths,
+# interpret-mode kernels, shard_map only via parallel/compat's cpu_mesh)
+# and is the only coverage of models/decode's ragged contracts here, since
+# test_decode.py is not collected on this runtime.
 collect_ignore = []
 if not hasattr(jax, "shard_map"):
     collect_ignore = [

@@ -135,6 +135,29 @@ class TestCLI:
         )
         assert len(a["tokens"][0]) == 8
 
+    def test_serve_mode(self):
+        # Continuous batching through the CLI glue: cache sizing from
+        # prompt_len + jitter + max_new, the synthetic trace, and the
+        # emitted throughput record (the engine itself is covered in
+        # tests/test_serving.py).
+        record, logs = run_cli(
+            "--mode", "serve", "--device", "cpu", "--slots", "2",
+            "--requests", "5", "--prompt-len", "8", "--prompt-jitter", "4",
+            "--arrival-every", "1", "--max-new-tokens", "4",
+            "--seq-len", "64", "--model-dim", "32", "--heads", "2",
+            "--head-dim", "16", "--vocab-size", "64", "--dtype", "float32",
+        )
+        assert record["mode"] == "serve"
+        assert record["slots"] == 2 and record["requests"] == 5
+        # Every slot must fit the worst-case prompt plus the full budget.
+        assert record["cache_len"] >= 8 + 4 + 4
+        assert record["tokens_generated"] == 5 * 4
+        assert record["outcomes"] == {"max_tokens": 5}
+        assert record["tokens_per_sec"] > 0
+        assert 0 < record["mean_occupancy"] <= 2
+        assert record["p50_s"] <= record["p95_s"]
+        assert "served 5 request(s)" in logs
+
     def test_train_mode_rejects_zero_steps(self):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)

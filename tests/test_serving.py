@@ -1,0 +1,439 @@
+"""Ragged-batch decode + continuous-batching scheduler tests (ISSUE 2).
+
+The three parity contracts of the ragged decode stack:
+
+(a) **equal-length slots reproduce lockstep generate() token-for-token**
+    (exact and quantized cache) — raggedness is a strict generalisation;
+(b) **mixed lengths match per-request single-stream decode** — no slot
+    reads another slot's cache rows, ever;
+(c) **scheduler property**: a random admit/retire trace delivers every
+    request exactly its tokens, identical to its own single-stream run.
+
+Everything here is CPU-safe and fast-tier: plain jnp paths plus the Pallas
+kernels in interpret mode, shard_map only through ``parallel/compat``
+(``cpu_mesh``) — it must stay collected on this container's legacy JAX
+(see tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tree_attention_tpu.models import (
+    TransformerConfig,
+    forward_step,
+    generate,
+    init_cache,
+    init_params,
+)
+from tree_attention_tpu.ops import attention_naive
+from tree_attention_tpu.ops.decode import default_num_splits, flash_decode
+from tree_attention_tpu.parallel import cpu_mesh
+from tree_attention_tpu.serving import Request, SlotServer, synthetic_trace
+
+CFG = TransformerConfig(
+    vocab_size=128,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_head=16,
+    d_ff=128,
+    max_seq_len=256,
+    dtype=jnp.float32,   # tight cross-path comparisons
+    attn_impl="blockwise",
+    attn_block_size=16,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _single_stream(params, prompt, n_new, cache_len=64):
+    """Per-request reference: one prompt, one stream, greedy."""
+    return np.asarray(
+        generate(params, jnp.asarray(prompt)[None], n_new, CFG,
+                 cache_len=cache_len)
+    )[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# satellite: default_num_splits scales its cap with context
+# ---------------------------------------------------------------------------
+
+
+def test_default_num_splits_scales_with_context():
+    # Short contexts keep the measured 16-way cap...
+    assert default_num_splits(1024, 512) == 2
+    assert default_num_splits(100, 512) == 1
+    assert default_num_splits(65536, 512) == 16
+    assert default_num_splits(16 * 16384, 512) == 16
+    # ...and past 256k tokens the cap grows one chunk per 16k tokens, so
+    # the chunked-vmap path keeps exposing parallelism.
+    assert default_num_splits(1 << 19, 512) == 32
+    assert default_num_splits(1 << 22, 512) == 256
+    # Never more chunks than blocks.
+    assert default_num_splits(1 << 22, 1 << 21) == 2
+
+
+# ---------------------------------------------------------------------------
+# ops-level ragged parity (test_decode.py is not collected on legacy JAX,
+# so the ragged kernel contracts are anchored here)
+# ---------------------------------------------------------------------------
+
+
+def test_flash_decode_ragged_matches_per_row_scalar():
+    """A (B,) q_position must equal B scalar-position calls bit-for-bit on
+    the chunked path (same chunking, same merge, per-row masking)."""
+    rng = np.random.default_rng(0)
+    B, Hq, Hkv, D, cap = 3, 4, 2, 16, 192
+    q = jnp.asarray(rng.standard_normal((B, Hq, 1, D), np.float32))
+    k = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    v = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    pos = jnp.asarray([4, 77, 191], jnp.int32)
+    out, lse = flash_decode(q, k, v, q_position=pos, num_splits=4)
+    for i in range(B):
+        o_i, l_i = flash_decode(
+            q[i:i + 1], k[i:i + 1], v[i:i + 1],
+            q_position=int(pos[i]), num_splits=4,
+        )
+        np.testing.assert_array_equal(np.asarray(out[i]), np.asarray(o_i[0]))
+        np.testing.assert_array_equal(np.asarray(lse[i]), np.asarray(l_i[0]))
+        L = int(pos[i]) + 1
+        ref, _ = attention_naive(q[i:i + 1], k[i:i + 1, :, :L],
+                                 v[i:i + 1, :, :L])
+        np.testing.assert_allclose(
+            np.asarray(out[i]), np.asarray(ref[0]), atol=2e-5, rtol=2e-5
+        )
+
+
+def test_pallas_decode_ragged_interpret():
+    """The Pallas flash-decode kernel's per-batch SMEM offsets (interpret
+    mode): each row masks its own tail."""
+    from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, D, cap = 3, 4, 2, 32, 256
+    q = jnp.asarray(rng.standard_normal((B, Hq, 1, D), np.float32))
+    k = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    v = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    pos = jnp.asarray([9, 100, 255], jnp.int32)
+    out, lse = attention_pallas_decode(q, k, v, causal=True, q_offset=pos)
+    for i in range(B):
+        L = int(pos[i]) + 1
+        ref_o, ref_l = attention_naive(q[i:i + 1], k[i:i + 1, :, :L],
+                                       v[i:i + 1, :, :L])
+        np.testing.assert_allclose(
+            np.asarray(out[i]), np.asarray(ref_o[0]), atol=3e-5, rtol=3e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse[i]), np.asarray(ref_l[0]), atol=3e-5, rtol=3e-5
+        )
+
+
+def test_pallas_decode_q8q_ragged_interpret():
+    """The int8-MXU kernel takes the same (B,) offsets."""
+    from tree_attention_tpu.ops.pallas_decode import (
+        attention_pallas_decode_q8q,
+        quantize_kv_channelwise,
+    )
+
+    rng = np.random.default_rng(2)
+    B, Hq, Hkv, D, cap = 2, 4, 2, 32, 128
+    q = jnp.asarray(rng.standard_normal((B, Hq, 1, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, Hkv, cap, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, Hkv, cap, D)), jnp.bfloat16)
+    k_q, v_q, k_s, v_s = quantize_kv_channelwise(k, v)
+    pos = jnp.asarray([17, 127], jnp.int32)
+    out, _ = attention_pallas_decode_q8q(
+        q, k_q, v_q, k_s, v_s, causal=True, q_offset=pos
+    )
+    for i in range(B):
+        L = int(pos[i]) + 1
+        ref, _ = attention_naive(q[i:i + 1], k[i:i + 1, :, :L],
+                                 v[i:i + 1, :, :L])
+        err = np.abs(
+            np.asarray(out[i], np.float32) - np.asarray(ref[0], np.float32)
+        ).max()
+        assert err < 0.15, (i, err)  # int8 error, not a masking bug
+
+
+def test_forward_step_ragged_matches_single_stream(params):
+    """Slots prefilled to different lengths step together and match each
+    slot's own B=1 step exactly — the model-level no-cross-talk contract."""
+    import dataclasses
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                CFG.vocab_size)
+    ca = init_cache(CFG, 1, 64)
+    _, ca = forward_step(params, tokens[:1, :16], ca, CFG)
+    cb = init_cache(CFG, 1, 64)
+    _, cb = forward_step(params, tokens[1:, :10], cb, CFG)
+    ragged = dataclasses.replace(
+        ca,
+        k=jnp.concatenate([ca.k, cb.k], axis=1),
+        v=jnp.concatenate([ca.v, cb.v], axis=1),
+        length=jnp.concatenate([ca.length, cb.length]),
+    )
+    nt = jnp.stack([tokens[0, 16], tokens[1, 10]])[:, None]
+    lr, ragged = forward_step(params, nt, ragged, CFG)
+    la, _ = forward_step(params, tokens[:1, 16:17], ca, CFG)
+    lb, _ = forward_step(params, tokens[1:, 10:11], cb, CFG)
+    np.testing.assert_allclose(np.asarray(lr[0]), np.asarray(la[0]),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lr[1]), np.asarray(lb[0]),
+                               atol=1e-5, rtol=1e-5)
+    assert np.asarray(ragged.length).tolist() == [17, 11]
+
+
+def test_forward_step_overflow_checks_max_slot(params):
+    """The eager overflow guard fires off the FULLEST slot, not the mean."""
+    import dataclasses
+
+    cache = init_cache(CFG, 2, 8)
+    cache = dataclasses.replace(
+        cache, length=jnp.asarray([2, 8], jnp.int32)
+    )
+    with pytest.raises(ValueError, match="overflow"):
+        forward_step(params, jnp.zeros((2, 1), jnp.int32), cache, CFG)
+
+
+# ---------------------------------------------------------------------------
+# (a) equal-length slots == lockstep generate()
+# ---------------------------------------------------------------------------
+
+
+def _as_requests(prompt, n_new, **kw):
+    return [
+        Request(uid=i, prompt=np.asarray(prompt[i]), max_new_tokens=n_new,
+                **kw)
+        for i in range(prompt.shape[0])
+    ]
+
+
+def test_equal_slots_reproduce_lockstep_generate(params):
+    B, Tp, n_new = 3, 12, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (B, Tp), 0,
+                                CFG.vocab_size)
+    ref = np.asarray(generate(params, prompt, n_new, CFG, cache_len=32))
+    server = SlotServer(params, CFG, slots=B, cache_len=32)
+    report = server.serve(_as_requests(prompt, n_new))
+    got = np.stack([np.asarray(r.tokens) for r in report.results])
+    np.testing.assert_array_equal(got, ref)
+    assert report.tokens_generated == B * n_new
+
+
+def test_equal_slots_reproduce_lockstep_generate_quantized(params):
+    """Same contract through the int8 cache: per-slot quantize-after-
+    prefill must equal the lockstep quantized path token-for-token."""
+    B, Tp, n_new = 2, 12, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (B, Tp), 0,
+                                CFG.vocab_size)
+    ref = np.asarray(generate(
+        params, prompt, n_new, CFG, cache_len=32,
+        quantize_after_prefill=True,
+    ))
+    server = SlotServer(params, CFG, slots=B, cache_len=32, quantize=True)
+    report = server.serve(_as_requests(prompt, n_new))
+    got = np.stack([np.asarray(r.tokens) for r in report.results])
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# (b) mixed lengths == per-request single-stream decode
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_lengths_match_single_stream(params):
+    base = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0,
+                              CFG.vocab_size)
+    reqs = [
+        Request(uid=0, prompt=np.asarray(base[0][:14]), max_new_tokens=5,
+                arrival_tick=0),
+        Request(uid=1, prompt=np.asarray(base[1][:7]), max_new_tokens=8,
+                arrival_tick=2),
+        Request(uid=2, prompt=np.asarray(base[2][:3]), max_new_tokens=4,
+                arrival_tick=3),
+        Request(uid=3, prompt=np.asarray(base[3][:9]), max_new_tokens=6,
+                arrival_tick=5),
+    ]
+    server = SlotServer(params, CFG, slots=2, cache_len=32)
+    report = server.serve(reqs)
+    assert len(report.results) == len(reqs)
+    for res in report.results:
+        req = next(r for r in reqs if r.uid == res.uid)
+        assert res.tokens == _single_stream(
+            params, req.prompt, req.max_new_tokens, cache_len=32
+        ), f"request {res.uid} diverged from its single-stream decode"
+        assert res.admit_tick >= req.arrival_tick
+
+
+def test_ragged_position_composes_with_data_axis(params):
+    """A (B,) q_position shards like the batch dim: generate() on a
+    data x seq mesh must still match the single-device run (regression —
+    the per-slot vector must not be rejected or replicated wrongly when
+    the batch is data-sharded)."""
+    mesh = cpu_mesh(4, {"data": 2, "seq": 2})
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 8), 0,
+                                CFG.vocab_size)
+    toks = generate(params, prompt, 4, CFG, mesh=mesh, cache_len=16)
+    ref = generate(params, prompt, 4, CFG, cache_len=16)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_serving_mesh_matches_single_device(params):
+    """The same trace over a seq-sharded slot cache (tree merge per tick,
+    shard_map via parallel/compat) reproduces the single-device tokens."""
+    mesh = cpu_mesh(2)
+    B, Tp, n_new = 2, 12, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (B, Tp), 0,
+                                CFG.vocab_size)
+    ref_server = SlotServer(params, CFG, slots=B, cache_len=32)
+    ref = ref_server.serve(_as_requests(prompt, n_new))
+    mesh_server = SlotServer(params, CFG, slots=B, cache_len=32, mesh=mesh)
+    got = mesh_server.serve(_as_requests(prompt, n_new))
+    for a, b in zip(ref.results, got.results):
+        assert a.tokens == b.tokens, (a.uid, a.tokens, b.tokens)
+
+
+# ---------------------------------------------------------------------------
+# (c) scheduler properties: random admit/retire traces
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_property_random_trace(params):
+    """Random prompts/lengths/budgets/arrivals through few slots: every
+    request finishes with exactly its budget, token-identical to its own
+    single-stream decode (no slot cross-talk), and scheduling invariants
+    hold (FIFO admission within arrival order, bounded occupancy)."""
+    rng = np.random.default_rng(11)
+    reqs = []
+    for i in range(7):
+        plen = int(rng.integers(2, 20))
+        reqs.append(Request(
+            uid=i,
+            prompt=rng.integers(0, CFG.vocab_size, size=plen).astype(np.int32),
+            max_new_tokens=int(rng.integers(1, 8)),
+            arrival_tick=int(rng.integers(0, 10)),
+        ))
+    server = SlotServer(params, CFG, slots=3, cache_len=32)
+    report = server.serve(reqs, max_ticks=500)
+    assert sorted(r.uid for r in report.results) == list(range(7))
+    for res in report.results:
+        req = next(r for r in reqs if r.uid == res.uid)
+        assert len(res.tokens) == req.max_new_tokens
+        assert res.tokens == _single_stream(
+            params, req.prompt, req.max_new_tokens, cache_len=32
+        ), f"request {res.uid} cross-talked"
+        assert res.admit_tick >= req.arrival_tick
+        assert res.finish_tick >= res.admit_tick
+    assert report.mean_occupancy <= server.slots + 1e-9
+    # Total work is conserved: prefill token + decode appends per request.
+    assert report.tokens_generated == sum(r.max_new_tokens for r in reqs)
+
+
+def test_eos_retires_slot_early(params):
+    """A sampled EOS frees the slot immediately (outcome 'eos', truncated
+    output) — pinned against the request's own single-stream decode."""
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(6), (10,), 0, CFG.vocab_size)
+    )
+    ref = _single_stream(params, prompt, 6, cache_len=32)
+    eos = ref[2]  # force an early stop at the third sampled token
+    server = SlotServer(params, CFG, slots=2, cache_len=32)
+    report = server.serve([
+        Request(uid=0, prompt=prompt, max_new_tokens=6, eos_id=eos)
+    ])
+    res = report.results[0]
+    assert res.outcome == "eos"
+    assert res.tokens == ref[:3]  # EOS included, nothing after
+
+
+def test_single_token_budget_retires_at_admit(params):
+    """max_new_tokens=1 finishes on the prefill sample alone — the trace
+    drains entirely in the admit phase with zero decode ticks and must
+    terminate cleanly (regression: the empty-queue fast-forward crashed)."""
+    server = SlotServer(params, CFG, slots=2, cache_len=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (3, 6), 0,
+                                CFG.vocab_size)
+    report = server.serve(_as_requests(prompt, 1))
+    assert sorted(r.uid for r in report.results) == [0, 1, 2]
+    for res in report.results:
+        assert len(res.tokens) == 1
+        assert res.tokens == _single_stream(
+            params, prompt[res.uid], 1, cache_len=32
+        )
+    assert report.tokens_generated == 3
+
+
+def test_admit_rejects_overcapacity(params):
+    server = SlotServer(params, CFG, slots=1, cache_len=16)
+    with pytest.raises(ValueError, match="capacity"):
+        server.serve([
+            Request(uid=0, prompt=np.zeros(12, np.int32), max_new_tokens=8)
+        ])
+
+
+def test_serve_rejects_zero_token_budget(params):
+    """The prefill itself samples one token, so a zero budget is
+    unservable — same contract as generate()."""
+    server = SlotServer(params, CFG, slots=1, cache_len=16)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        server.serve([
+            Request(uid=0, prompt=np.zeros(4, np.int32), max_new_tokens=0)
+        ])
+
+
+def test_serving_data_axis_mesh(params):
+    """A mesh with a data axis serves too: the B=1 prefill drops the data
+    axis (1 cannot shard over it) while the batched step keeps the full
+    spec (regression — the first admit crashed in shard_map)."""
+    mesh = cpu_mesh(4, {"data": 2, "seq": 2})
+    B, Tp, n_new = 2, 10, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (B, Tp), 0,
+                                CFG.vocab_size)
+    got = SlotServer(params, CFG, slots=B, cache_len=16, mesh=mesh).serve(
+        _as_requests(prompt, n_new)
+    )
+    ref = SlotServer(params, CFG, slots=B, cache_len=16).serve(
+        _as_requests(prompt, n_new)
+    )
+    for a, b in zip(ref.results, got.results):
+        assert a.tokens == b.tokens, (a.uid, a.tokens, b.tokens)
+
+
+def test_synthetic_trace_shape():
+    trace = synthetic_trace(5, prompt_len=8, prompt_jitter=3,
+                            max_new_tokens=4, arrival_every=2, seed=1)
+    assert [r.arrival_tick for r in trace] == [0, 2, 4, 6, 8]
+    assert all(5 <= len(r.prompt) <= 11 for r in trace)
+    assert all(r.max_new_tokens == 4 for r in trace)
+
+
+def test_serving_metrics_flow(params):
+    """The four serving metrics record when the registry is armed."""
+    from tree_attention_tpu import obs
+
+    obs.enable()
+    try:
+        reg = obs.REGISTRY
+        tokens0 = reg.counter("serving_tokens_total").value()
+        server = SlotServer(params, CFG, slots=2, cache_len=32)
+        prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0,
+                                    CFG.vocab_size)
+        report = server.serve(_as_requests(prompt, 3))
+        assert (
+            reg.counter("serving_tokens_total").value() - tokens0
+            == report.tokens_generated
+        )
+        done = reg.counter(
+            "serving_requests_total", labels=("outcome",)
+        ).labels(outcome="max_tokens").value()
+        assert done >= 2
+        hist = reg.histogram("serving_queue_wait_seconds")
+        assert hist._value_payload()["count"] >= 2
+    finally:
+        obs.disable()

@@ -15,7 +15,8 @@ honestly (fenced, repeated, median) and steered by real flags (SURVEY.md §5):
 
 Modes: ``decode`` (one attention step over a KV cache), ``train`` (LM steps on
 the flagship transformer), ``generate`` (prefill + autoregressive decode),
-``bench`` (the harness; prints one JSON record on stdout).
+``serve`` (continuous batching: a slot scheduler drains a synthetic request
+trace), ``bench`` (the harness; prints one JSON record on stdout).
 """
 
 from __future__ import annotations
@@ -452,6 +453,79 @@ def _run_generate(cfg: RunConfig, mesh) -> int:
     return 0
 
 
+def _run_serve(cfg: RunConfig, mesh) -> int:
+    """Continuous batching over a synthetic request trace: the slot
+    scheduler admits/retires requests while one compiled ragged decode step
+    serves every live slot per tick (``tree_attention_tpu/serving``)."""
+    import jax
+
+    from tree_attention_tpu.models import init_params
+    from tree_attention_tpu.serving import SlotServer, synthetic_trace
+
+    if cfg.max_new_tokens < 1:
+        raise SystemExit("--max-new-tokens must be >= 1")
+    if cfg.slots < 1:
+        raise SystemExit("--slots must be >= 1")
+    if cfg.prompt_len - cfg.prompt_jitter < 1:
+        raise SystemExit("--prompt-jitter must leave prompts >= 1 token")
+    if cfg.kv_quant != "none" and cfg.impl not in ("auto", "pallas_decode"):
+        raise SystemExit(
+            f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
+            f"--impl {cfg.impl} cannot serve a quantized buffer"
+        )
+    # The cache is sized from the trace itself: longest possible prompt
+    # plus the per-request budget, through the same rounding rule
+    # generate() uses.
+    from tree_attention_tpu.models.decode import round_cache_len
+
+    cache_len = round_cache_len(
+        cfg.prompt_len + cfg.prompt_jitter + cfg.max_new_tokens, mesh
+    )
+    import dataclasses as _dc
+
+    tcfg = _transformer_config(_dc.replace(cfg, seq_len=cache_len))
+    params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
+    trace = synthetic_trace(
+        cfg.requests,
+        prompt_len=cfg.prompt_len,
+        prompt_jitter=cfg.prompt_jitter,
+        max_new_tokens=cfg.max_new_tokens,
+        arrival_every=cfg.arrival_every,
+        vocab_size=tcfg.vocab_size,
+        seed=cfg.seed + 1,
+    )
+    server = SlotServer(
+        params, tcfg,
+        slots=cfg.slots, cache_len=cache_len, mesh=mesh,
+        quantize=cfg.kv_quant != "none",
+        quant_kernel=cfg.resolved_quant_kernel() or "q8q",
+        temperature=cfg.temperature, seed=cfg.seed + 2,
+    )
+    from tree_attention_tpu.host_runtime import heartbeat
+
+    heartbeat()
+    report = server.serve(trace)
+    heartbeat()
+    log.info(
+        "served %d requests on %d slot(s): %.1f tokens/s aggregate, "
+        "mean occupancy %.2f",
+        len(report.results), cfg.slots, report.tokens_per_sec,
+        report.mean_occupancy,
+    )
+    _emit({
+        "mode": "serve",
+        "slots": cfg.slots,
+        "cache_len": cache_len,
+        **report.as_dict(),
+        "outcomes": {
+            o: sum(1 for r in report.results if r.outcome == o)
+            for o in sorted({r.outcome for r in report.results})
+        },
+        **({"kv_quant": cfg.kv_quant} if cfg.kv_quant != "none" else {}),
+    })
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     cfg = parse_args(argv)
     # Under --launch, every child would otherwise open (and rotate) the same
@@ -497,6 +571,7 @@ def main(argv: Optional[list] = None) -> int:
             "decode": _run_decode,
             "train": _run_train,
             "generate": _run_generate,
+            "serve": _run_serve,
             "bench": _run_bench,
         }[cfg.mode]
         with trace(cfg.profile_dir), obs.span(

@@ -6,25 +6,30 @@ driver decodes one token against freshly random KV and discards the result
 (``/root/reference/model.py:129-155``). This module provides the real thing:
 
 - :class:`KVCache` — a pytree of per-layer K/V buffers ``(L, B, Hkv, Tmax, D)``
-  plus a traced ``length``. Under a mesh the buffers are **sequence-sharded**
-  (``P(None, data, model, seq, None)``), so a 256k-token cache lives as
-  Tmax/N-token shards — context capacity scales with the mesh, the point of
-  tree attention.
-- :func:`forward_step` — one model step over ``Tq`` new tokens: writes their
-  K/V into the cache at ``[length, length+Tq)`` and attends causally against
-  the whole buffer. Static shapes throughout (``length`` is data, not shape):
-  one compilation serves every step. Prefill is the same function with the
-  prompt as one big step.
+  plus a traced per-slot ``length`` vector ``(B,)``. Under a mesh the buffers
+  are **sequence-sharded** (``P(None, data, model, seq, None)``), so a
+  256k-token cache lives as Tmax/N-token shards — context capacity scales
+  with the mesh, the point of tree attention.
+- :func:`forward_step` — one model step over ``Tq`` new tokens per slot:
+  writes each slot's K/V rows at that slot's own ``[length[i], length[i]+Tq)``
+  (a vmapped dynamic-update over batch) and attends causally against the
+  whole buffer. Static shapes throughout (``length`` is data, not shape):
+  one compilation serves every step AND every mixture of per-slot lengths —
+  the property continuous batching (:mod:`tree_attention_tpu.serving`)
+  is built on. Prefill is the same function with the prompt as one big step.
 - :func:`generate` — prefill + ``lax.scan`` of single-token steps, greedy or
-  temperature sampling, donate-friendly.
+  temperature sampling, donate-friendly (all slots in lockstep — the
+  equal-lengths special case of the ragged machinery).
 
-Masking needs no separate "valid length" machinery: query ``i`` of a step sits
-at global position ``length + i`` and the causal rule ``q_pos >= k_pos``
-already hides every cache slot ``>= length`` (they are the future). Cache
+Masking needs no separate "valid length" machinery: slot ``i``'s query ``j``
+sits at global position ``length[i] + j`` and the causal rule
+``q_pos >= k_pos`` already hides every cache row ``>= length[i]`` (they are
+that slot's future) — per-row offsets, same online-softmax monoid. Cache
 attention routes through :func:`tree_decode
 <tree_attention_tpu.parallel.tree.tree_decode>` on a sequence-parallel mesh
 (replicated Q, one pmax + one fused psum) and through :func:`flash_decode
-<tree_attention_tpu.ops.decode.flash_decode>` (split-KV) on a single device.
+<tree_attention_tpu.ops.decode.flash_decode>` (split-KV) on a single device —
+both take the per-slot ``(B,)`` ``q_position``.
 """
 
 from __future__ import annotations
@@ -82,11 +87,11 @@ from tree_attention_tpu.parallel.mesh import (
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    """Per-layer KV buffers ``(L, B, Hkv, Tmax, D)`` and the filled length."""
+    """Per-layer KV buffers ``(L, B, Hkv, Tmax, D)`` and per-slot lengths."""
 
     k: jax.Array
     v: jax.Array
-    length: jax.Array  # () int32 — tokens written so far
+    length: jax.Array  # (B,) int32 — tokens written so far, per slot
 
     @property
     def capacity(self) -> int:
@@ -111,7 +116,7 @@ class QuantKVCache:
     v: jax.Array        # (L, B, Hkv, Tmax, D) int8
     k_scale: jax.Array  # (L, B, Hkv, 1, D) float32
     v_scale: jax.Array  # (L, B, Hkv, 1, D) float32
-    length: jax.Array   # () int32
+    length: jax.Array   # (B,) int32 — per slot
 
     @property
     def capacity(self) -> int:
@@ -190,7 +195,7 @@ def init_cache(
     if obs.REGISTRY.enabled:
         _CACHE_CAPACITY.set(max_len)
         _CACHE_ALLOCS.labels(sharded=str(mesh is not None).lower()).inc()
-    return KVCache(k=k, v=v, length=jnp.zeros((), jnp.int32))
+    return KVCache(k=k, v=v, length=jnp.zeros((batch_size,), jnp.int32))
 
 
 def forward_step(
@@ -209,9 +214,11 @@ def forward_step(
     """Run ``Tq`` new tokens through the model against the cache.
 
     Args:
-      tokens: ``(B, Tq)`` token ids occupying global positions
-        ``[cache.length, cache.length + Tq)``. ``Tq`` is the prompt length at
-        prefill and 1 in the decode loop — both hit the same code path.
+      tokens: ``(B, Tq)`` token ids; row ``i`` occupies global positions
+        ``[cache.length[i], cache.length[i] + Tq)`` of its own slot — slots
+        need not agree (the ragged-batch shape continuous batching serves).
+        ``Tq`` is the prompt length at prefill and 1 in the decode loop —
+        both hit the same code path.
 
     Returns:
       ``logits``: ``(B, Tq, vocab)`` float32; the updated cache
@@ -227,17 +234,25 @@ def forward_step(
     )
 
     B, Tq = tokens.shape
-    start = cache.length
-    if not isinstance(start, jax.core.Tracer) and int(start) + Tq > cache.capacity:
+    start = cache.length  # (B,) per-slot offsets
+    if not isinstance(start, jax.core.Tracer):
         # Only checkable eagerly: under jit ``length`` is traced and an
         # overflowing write would silently clamp (dynamic_update_slice
         # semantics), corrupting the newest rows — callers sizing their own
-        # caches must keep length + Tq <= capacity (generate() does).
-        raise ValueError(
-            f"KV cache overflow: length {int(start)} + {Tq} new tokens "
-            f"exceeds capacity {cache.capacity}"
-        )
-    positions = start + jnp.arange(Tq, dtype=jnp.int32)
+        # caches must keep max(length) + Tq <= capacity (generate() does;
+        # the serving engine retires slots before their budget can). The
+        # max runs in numpy: a jnp reduction here would be silently lifted
+        # into any enclosing trace (a concrete cache closed over by a
+        # scanned step) and break the isinstance guard.
+        import numpy as np
+
+        hi = int(np.max(np.asarray(start)))
+        if hi + Tq > cache.capacity:
+            raise ValueError(
+                f"KV cache overflow: length {hi} + {Tq} new tokens "
+                f"exceeds capacity {cache.capacity}"
+            )
+    positions = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)  # (B, Tq)
 
     x = jnp.take(params["embed"], tokens, axis=0)
     quant = isinstance(cache, QuantKVCache)
@@ -256,18 +271,20 @@ def forward_step(
         q = rope(q, positions, cfg.rope_theta)
         k_new = rope(k_new, positions, cfg.rope_theta)
 
-        # Write the new rows at [start, start+Tq). Under a mesh GSPMD turns
-        # the dynamic-update into per-shard masked writes on the seq dim.
+        # Write slot i's new rows at its own [start[i], start[i]+Tq): a
+        # vmapped dynamic-update over batch (per-slot token offsets). Under
+        # a mesh GSPMD turns it into per-shard masked writes on the seq dim.
         # Quantized caches quantize the rows under the frozen scales first.
         if quant:
             k_new = _quantize_rows(k_new, k_s)
             v_new = _quantize_rows(v_new, v_s)
-        k_cache = lax.dynamic_update_slice_in_dim(
-            k_cache, k_new.astype(k_cache.dtype), start, axis=2
+        write = jax.vmap(
+            lambda buf, rows, s: lax.dynamic_update_slice_in_dim(
+                buf, rows, s, axis=1
+            )
         )
-        v_cache = lax.dynamic_update_slice_in_dim(
-            v_cache, v_new.astype(v_cache.dtype), start, axis=2
-        )
+        k_cache = write(k_cache, k_new.astype(k_cache.dtype), start)
+        v_cache = write(v_cache, v_new.astype(v_cache.dtype), start)
 
         attn_kw = dict(
             q_position=start,
@@ -305,6 +322,17 @@ def forward_step(
     else:
         new_cache = KVCache(k=new_k, v=new_v, length=start + Tq)
     return logits, new_cache
+
+
+def round_cache_len(
+    total: int, mesh: Optional[Mesh] = None, seq_axis: str = AXIS_SEQ
+) -> int:
+    """Cache capacity for ``total`` tokens, rounded up to the mesh's
+    seq-shard multiple — the ONE sizing rule :func:`generate` and the
+    serving CLI share (a capacity that does not divide over the seq axis
+    is rejected by :func:`init_cache`)."""
+    shards = mesh.shape.get(seq_axis, 1) if mesh is not None else 1
+    return total + (-total) % max(shards, 1)
 
 
 def _sample(logits: jax.Array, temperature: float, key: Optional[jax.Array]):
@@ -352,8 +380,7 @@ def generate(
     B, Tp = prompt.shape
     total = Tp + max_new_tokens
     if cache_len is None:
-        shards = mesh.shape.get(seq_axis, 1) if mesh is not None else 1
-        cache_len = total + (-total) % max(shards, 1)
+        cache_len = round_cache_len(total, mesh, seq_axis)
     if cache_len < total:
         raise ValueError(f"cache_len={cache_len} < prompt+new={total}")
     if temperature > 0.0 and key is None:
@@ -406,7 +433,10 @@ def decode_attention(
     The two are the same algorithm at different granularity (chunks vs
     shards); this picks by topology so callers write one line. This is the
     single home of that dispatch rule — :func:`forward_step` routes through
-    it for both the exact and the quantized cache. Passing ``k_scale`` /
+    it for both the exact and the quantized cache. ``q_position`` may be a
+    scalar or a per-slot ``(B,)`` vector (the ragged-batch shape); every
+    path — flash_decode, the q8 kernels, and both tree merges — masks each
+    row against its own offset. Passing ``k_scale`` /
     ``v_scale`` (with int8 ``k``/``v``) selects the q8 kernels, and
     ``quant_kernel`` picks which: ``"q8q"`` (default) runs scores natively
     int8 × int8 on the MXU — the fastest decode path (measured 92% vs 86%

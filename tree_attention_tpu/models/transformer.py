@@ -187,15 +187,19 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding on ``(B, H, T, D)`` with global ``positions (T,)``.
+    """Rotary position embedding on ``(B, H, T, D)``; ``positions`` is
+    ``(T,)`` shared across the batch or ``(B, T)`` per-row (the ragged
+    decode shape: every cache slot sits at its own global offset).
 
     Positions are *global* sequence indices: under sequence parallelism each
     shard passes its own offset slice, so rotations agree across the mesh.
     """
     half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if angles.ndim == 3:  # (B, T, half): broadcast over the head dim
+        cos, sin = cos[:, None], sin[:, None]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1

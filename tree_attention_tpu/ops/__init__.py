@@ -13,9 +13,10 @@ done right). Implementations:
 - ``"auto"``      — decode shapes (Tq < 128) resolve to the flash-decode
   kernel on TPU (any context length; no score transient) and to ``naive``
   elsewhere when the score transient is small; large-Tq shapes resolve to
-  ``pallas`` on TPU (``TREE_ATTN_AUTO_PALLAS=0`` opts out of both kernels)
-  and ``blockwise`` elsewhere. Pass an explicit impl when a specific kernel
-  or backward path must be used.
+  ``pallas`` on TPU (``TREE_ATTN_AUTO_PALLAS=0`` opts out of both kernels;
+  the decode paths read the variable once at import, so set it before
+  importing the package) and ``blockwise`` elsewhere. Pass an explicit impl
+  when a specific kernel or backward path must be used.
 """
 
 from __future__ import annotations

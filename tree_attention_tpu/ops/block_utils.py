@@ -137,6 +137,18 @@ def mask_scores(s, qi, ki, block_q: int, block_k: int, q_offset, kv_offset,
     return jnp.where(valid, s, NEG_INF)
 
 
+def offsets_smem(q_offset, kv_offset, batch: int) -> jax.Array:
+    """(2, B) int32 SMEM operand: per-batch [q_offset | kv_offset] rows.
+
+    Scalars broadcast to every batch row; a ``(B,)`` vector gives each row
+    (cache slot) its own global position — the ragged-batch contract shared
+    by every offset-taking Pallas kernel (a kernel with batch-major grid
+    dim 0 indexes column ``program_id(0) // heads_per_batch``)."""
+    q_off = jnp.broadcast_to(jnp.asarray(q_offset, jnp.int32), (batch,))
+    kv_off = jnp.broadcast_to(jnp.asarray(kv_offset, jnp.int32), (batch,))
+    return jnp.stack([q_off, kv_off])
+
+
 def static_offsets(q_offset, kv_offset) -> bool:
     """Whether both causal shard offsets are compile-time integers.
 

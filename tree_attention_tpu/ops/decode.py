@@ -23,6 +23,7 @@ future.
 from __future__ import annotations
 
 import numbers
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -31,6 +32,15 @@ import jax.numpy as jnp
 from tree_attention_tpu import obs
 from tree_attention_tpu.ops.block_utils import pad_to_block
 from tree_attention_tpu.ops.reference import attention_blockwise, merge_partials
+
+# Read once at import: this gate sits on the per-layer hot dispatch path of
+# every decode step (a scan body traces it L times per compile, and eager
+# callers hit it per call); the env var is a process-level opt-out, not a
+# runtime toggle — flipping it after import is not supported here (the
+# already-jitted callers it would need to invalidate cannot see an env flip
+# anyway; ops/__init__.flash_attention keeps per-call reads for its
+# eager-auto path).
+_AUTO_PALLAS = os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
 
 # Dispatch accounting (trace-time under an enclosing jit — see
 # obs.metrics): which decode path served the call, and how many KV/query
@@ -57,8 +67,16 @@ def _account_dispatch(path: str, kv_tokens: int) -> None:
 
 
 def default_num_splits(kv_len: int, block_size: int) -> int:
-    """Enough chunks to expose parallelism, never smaller than one block."""
-    return max(1, min(16, kv_len // max(block_size, 1)))
+    """Enough chunks to expose parallelism, never smaller than one block.
+
+    The cap scales with context: a flat 16 under-parallelises the
+    chunked-vmap path at 256k+ tokens (16 chunks of 16k+ each serialise
+    inside one ``lax.scan`` apiece), so beyond 256k tokens the cap grows
+    linearly — one extra chunk per 16k tokens — while short contexts keep
+    the measured 16-way default.
+    """
+    cap = max(16, kv_len // 16384)
+    return max(1, min(cap, kv_len // max(block_size, 1)))
 
 
 def flash_decode(
@@ -82,11 +100,17 @@ def flash_decode(
       q_position: global position of the first query row. Defaults to
         ``Tk - Tq`` (queries are the newest tokens of a fully-valid buffer).
         May be a traced scalar — decode steps jit once and run at every
-        sequence length.
+        sequence length — or a ``(B,)`` vector for a **ragged batch**:
+        each batch row is a cache slot with its own filled length, and the
+        causal rule masks every row's unwritten tail independently (slot
+        ``i``'s query sits at ``q_position[i]``; everything beyond is its
+        masked future).
       num_splits: KV chunks computed in parallel on the chunked-vmap (CPU)
-        path; default scales with ``Tk / block_size`` (capped at 16). The
-        TPU Pallas kernel is split-KV internally (one chunk per ``block_size``
-        KV tile), so this knob is inert there.
+        path; default scales with ``Tk / block_size``, capped at
+        ``max(16, Tk // 16384)`` (see :func:`default_num_splits` — the cap
+        grows with context so 256k+ buffers keep exposing parallelism).
+        The TPU Pallas kernel is split-KV internally (one chunk per
+        ``block_size`` KV tile), so this knob is inert there.
       block_size: KV tile length. ``None`` picks the impl-appropriate
         default (the measured :mod:`~tree_attention_tpu.ops.tuning` table
         for the flash-decode kernel, 512 for the Q-tiled prefill kernel and
@@ -99,19 +123,15 @@ def flash_decode(
     Tk = k.shape[2]
     if q_position is None:
         q_position = Tk - Tq
+    # Ragged batch: one q_position per batch row (cache slot).
+    ragged = getattr(q_position, "ndim", 0) == 1
 
     # On TPU the Pallas flash-decode kernel subsumes the chunked-vmap form:
     # it is itself split-KV (sequential KV tiles with carried online-softmax
     # state) and streams at the HBM roofline at any context length.
-    import os
-
     from tree_attention_tpu.ops import _on_tpu, _pallas_available
 
-    if (
-        os.environ.get("TREE_ATTN_AUTO_PALLAS", "1") != "0"
-        and _on_tpu(q)
-        and _pallas_available()
-    ):
+    if _AUTO_PALLAS and _on_tpu(q) and _pallas_available():
         # Kernel choice and tile defaults live in ops.tuning (shared with
         # flash_attention's auto gate). Prefill-sized Tq takes the Q-tiled
         # kernel: the decode kernel's group packing would spill into
@@ -148,6 +168,9 @@ def flash_decode(
 
             kernel = attention_pallas_fwd
         _account_dispatch(impl, Tk)
+        # Both kernels take scalar OR (B,) offsets (per-batch SMEM
+        # columns), so ragged and uniform batches are one dispatch either
+        # way.
         return kernel(
             q, k, v, causal=True, scale=scale,
             q_offset=q_position, kv_offset=0, block_size=bk,
@@ -168,6 +191,21 @@ def flash_decode(
     offsets = jnp.arange(S) * chunk
 
     def one_chunk(k_s: jax.Array, v_s: jax.Array, off: jax.Array):
+        if ragged:
+            # Per-slot offsets: vmap the online-softmax scan over batch so
+            # each row masks against its own q_position. Same chunking,
+            # same merge — a row's partials are identical to the scalar
+            # path's, so ragged and uniform batches agree bit-for-bit.
+            def per_slot(q_b, k_b, v_b, pos_b):
+                o, l = attention_blockwise(
+                    q_b[None], k_b[None], v_b[None],
+                    causal=True, scale=scale,
+                    q_offset=pos_b, kv_offset=off,
+                    block_size=min(block_size, chunk),
+                )
+                return o[0], l[0]
+
+            return jax.vmap(per_slot)(q, k_s, v_s, q_position)
         return attention_blockwise(
             q, k_s, v_s,
             causal=True, scale=scale,

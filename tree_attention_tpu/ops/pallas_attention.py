@@ -63,7 +63,8 @@ def _lane_bcast(x, n):
 
 
 def _flash_fwd_kernel(
-    offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
+    offs_ref,  # SMEM (2, B): per-batch [q_offset | kv_offset] columns —
+               # ragged prefill gives every batch row its own global position
     q_ref,     # VMEM (1, bq, D)
     k_ref,     # VMEM (1, bk, D)
     v_ref,     # VMEM (1, bk, D)
@@ -79,13 +80,15 @@ def _flash_fwd_kernel(
     tk: int,
     block_q: int,
     block_k: int,
+    n_q_heads: int,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
 
-    q_offset = offs_ref[0, 0]
-    kv_offset = offs_ref[1, 0]
+    b = pl.program_id(0) // n_q_heads  # grid dim 0 runs over B·Hq
+    q_offset = offs_ref[0, b]
+    kv_offset = offs_ref[1, b]
 
     @pl.when(ki == 0)
     def _init():
@@ -197,6 +200,12 @@ def attention_pallas_fwd(
     offsets (``shard_map``) keep the ``pl.when`` compute skip only. Offsets
     become part of the compile key only in the static case, so a loop over
     *varying* integer offsets should pass them as arrays.
+
+    ``q_offset`` / ``kv_offset`` may also be ``(B,)`` vectors (the ragged
+    prefill shape: each batch row is a cache slot at its own position);
+    per-batch offsets ride SMEM like the decode kernel's, with the
+    ``pl.when`` compute skip per batch row (no grid culling — the grid is
+    shared across rows).
     """
     cull = (
         (int(q_offset), int(kv_offset))
@@ -257,9 +266,9 @@ def _attention_pallas_fwd(
     n_q, n_k = -(-Tq // bq), -(-Tk // bk)
     tq_pad = n_q * bq
 
-    offs = jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
-    ).reshape(2, 1)
+    from tree_attention_tpu.ops.block_utils import offsets_smem
+
+    offs = offsets_smem(q_offset, kv_offset, B)
 
     grid = (B * Hq, n_q, n_k)
 
@@ -271,6 +280,7 @@ def _attention_pallas_fwd(
         functools.partial(
             _flash_fwd_kernel,
             scale=s, causal=causal, tk=Tk, block_q=bq, block_k=bk,
+            n_q_heads=Hq,
         ),
         grid=grid,
         in_specs=[

@@ -83,10 +83,12 @@ def _recompute_p_ds(q, k, v, dout, lse, delta, *, scale, causal,
 
 
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
-               dq_ref, dq_scr, *, scale, causal, tk, block_q, block_k):
+               dq_ref, dq_scr, *, scale, causal, tk, block_q, block_k,
+               n_heads):
     qi, ki = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
-    q_offset, kv_offset = offs_ref[0, 0], offs_ref[1, 0]
+    b = pl.program_id(0) // n_heads  # grid dim 0 runs over B*Hq
+    q_offset, kv_offset = offs_ref[0, b], offs_ref[1, b]
 
     @pl.when(ki == 0)
     def _():
@@ -116,10 +118,11 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
 
 def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, res_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, tk, block_q, block_k, n_q):
+                *, scale, causal, tk, block_q, block_k, n_q, n_heads):
     ki, gq = pl.program_id(1), pl.program_id(2)
     n_gq = pl.num_programs(2)
-    q_offset, kv_offset = offs_ref[0, 0], offs_ref[1, 0]
+    b = pl.program_id(0) // n_heads  # grid dim 0 runs over B*Hkv
+    q_offset, kv_offset = offs_ref[0, b], offs_ref[1, b]
 
     @pl.when(gq == 0)
     def _():
@@ -264,9 +267,11 @@ def _attention_bwd_pallas(
     res_b = jnp.zeros((B * Hq, tq_pad, _LANES), jnp.float32)
     res_b = res_b.at[..., 0].set(lse_f).at[..., DELTA_LANE].set(delta)
 
-    offs = jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
-    ).reshape(2, 1)
+    from tree_attention_tpu.ops.block_utils import offsets_smem
+
+    # (2, B) per-batch offset columns — same ragged contract as the fwd
+    # kernels (scalars broadcast; the kernels index their own batch row).
+    offs = offsets_smem(q_offset, kv_offset, B)
 
     def kv_from_qrow(bh, *_rest):
         return bh // Hq * Hkv + (bh % Hq) // G
@@ -278,6 +283,7 @@ def _attention_bwd_pallas(
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=s, causal=causal, tk=Tk, block_q=bq, block_k=bk,
+            n_heads=Hq,
         ),
         grid=(B * Hq, n_q, n_k),
         in_specs=[
@@ -311,7 +317,7 @@ def _attention_bwd_pallas(
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=s, causal=causal, tk=Tk, block_q=bq,
-            block_k=bk, n_q=n_q,
+            block_k=bk, n_q=n_q, n_heads=Hkv,
         ),
         grid=(B * Hkv, n_k, G * n_q),
         in_specs=[

@@ -46,6 +46,7 @@ from tree_attention_tpu.ops.block_utils import (
     LANES as _LANES,
     NEG_INF,
     matmul_precision,
+    offsets_smem as _offsets_smem,
     pad_to_block as _pad_dim,
     tpu_compiler_params,
 )
@@ -144,7 +145,8 @@ def _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr):
 
 
 def _flash_decode_kernel(
-    offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
+    offs_ref,  # SMEM (2, B): per-batch [q_offset | kv_offset] columns —
+               # ragged caches give every batch row its own global position
     q_ref,     # VMEM (1, bq, D) — packed (group × Tq) queries of one KV head
     k_ref,     # VMEM (1, bk, D)
     v_ref,     # VMEM (1, bk, D)
@@ -161,13 +163,15 @@ def _flash_decode_kernel(
     tq: int,
     block_q: int,
     block_k: int,
+    n_kv_heads: int,
 ):
     qi = pl.program_id(1)
     si = pl.program_id(2)
     n_s = pl.num_programs(2)
 
-    q_offset = offs_ref[0, 0]
-    kv_offset = offs_ref[1, 0]
+    b = pl.program_id(0) // n_kv_heads  # grid dim 0 runs over B·Hkv
+    q_offset = offs_ref[0, b]
+    kv_offset = offs_ref[1, b]
 
     @pl.when(si == 0)
     def _init():
@@ -217,7 +221,7 @@ def _flash_decode_kernel(
 
 
 def _flash_decode_q8q_kernel(
-    offs_ref,  # SMEM (2, 1): [q_offset, kv_offset]
+    offs_ref,  # SMEM (2, B): per-batch [q_offset | kv_offset] columns
     q_ref,     # VMEM (1, bq, D) int8 — per-row-quantized, scale-folded Q
     qs_ref,    # VMEM (1, bq, LANES) f32 — per-row Q scales (lane-broadcast)
     k_ref,     # VMEM (1, bk, D) int8
@@ -233,6 +237,7 @@ def _flash_decode_q8q_kernel(
     tq: int,
     block_q: int,
     block_k: int,
+    n_kv_heads: int,
 ):
     """The int8-MXU variant of :func:`_flash_decode_kernel`: scores run
     natively int8 x int8 -> int32 (no K dequant cast on the KV stream — the
@@ -246,8 +251,9 @@ def _flash_decode_q8q_kernel(
     si = pl.program_id(2)
     n_s = pl.num_programs(2)
 
-    q_offset = offs_ref[0, 0]
-    kv_offset = offs_ref[1, 0]
+    b = pl.program_id(0) // n_kv_heads
+    q_offset = offs_ref[0, b]
+    kv_offset = offs_ref[1, b]
 
     @pl.when(si == 0)
     def _init():
@@ -483,9 +489,7 @@ def attention_pallas_decode_q8q(
     vp = v_q.reshape(B * Hkv, Tk, D)
     n_s = -(-Tk // bk)
 
-    offs = jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
-    ).reshape(2, 1)
+    offs = _offsets_smem(q_offset, kv_offset, B)
 
     if obs.REGISTRY.enabled:
         _KERNEL_BUILDS.labels(kernel="q8q").inc()
@@ -493,6 +497,7 @@ def attention_pallas_decode_q8q(
         functools.partial(
             _flash_decode_q8q_kernel,
             causal=causal, tk=Tk, tq=Tq, block_q=bq, block_k=bk,
+            n_kv_heads=Hkv,
         ),
         grid=(B * Hkv, n_q, n_s),
         in_specs=[
@@ -553,6 +558,11 @@ def attention_pallas_decode(
     (:func:`tree_attention_tpu.ops.pallas_attention.attention_pallas_fwd`)
     is the right shape for large Tq. ``interpret=None`` auto-selects:
     compiled on TPU, interpreter elsewhere (what CI exercises on CPU).
+
+    ``q_offset`` (and ``kv_offset``) may be a scalar or a ``(B,)`` vector —
+    the ragged-batch shape: each batch row is a cache slot with its own
+    filled length, and the causal mask hides every row's unwritten future
+    independently (offsets ride SMEM; the grid and tiles are unchanged).
     """
     B, Hq, Tq, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
@@ -608,9 +618,7 @@ def attention_pallas_decode(
     vp = v.reshape(B * Hkv, Tk, D)
     n_s = -(-Tk // bk)
 
-    offs = jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
-    ).reshape(2, 1)
+    offs = _offsets_smem(q_offset, kv_offset, B)
 
     if obs.REGISTRY.enabled:
         # int8 operands here are the q8 (bf16-cast) path riding the base
@@ -622,6 +630,7 @@ def attention_pallas_decode(
         functools.partial(
             _flash_decode_kernel,
             scale=s, causal=causal, tk=Tk, tq=Tq, block_q=bq, block_k=bk,
+            n_kv_heads=Hkv,
         ),
         grid=(B * Hkv, n_q, n_s),
         in_specs=[

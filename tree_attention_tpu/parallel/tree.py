@@ -200,12 +200,21 @@ def _tree_decode_common(
     ``local_attn(q_l, kv_locals, rep_locals, q_position, kv_offset)`` returns
     the per-shard ``(out, lse)`` — the one thing the exact and quantized
     paths differ in.
+
+    ``q_position`` may be a per-slot ``(B,)`` vector (the ragged-batch
+    serving shape): each batch row masks against its own global offset on
+    every shard, and the merge is unchanged (the monoid never looks at
+    positions). The vector enters the shard body as a proper shard_map
+    operand sharded like the batch dim (``P(data_axis)``), so it composes
+    with data parallelism — each device sees exactly its own rows'
+    offsets.
     """
     payload = resolve_merge_payload(merge_payload)
     Tk_global = kv_arrays[0].shape[2]
     Tq = q.shape[2]
     if q_position is None:
         q_position = Tk_global - Tq
+    ragged = getattr(q_position, "ndim", 0) == 1
     n_shards = mesh.shape[seq_axis]
     if Tk_global % n_shards:
         raise ValueError(
@@ -217,6 +226,7 @@ def _tree_decode_common(
     q_spec = P(data_axis, head_axis, None, None)
     kv_spec = P(data_axis, head_axis, seq_axis, None)
     rep_spec = P(data_axis, head_axis, None, None)
+    pos_args = (jnp.asarray(q_position, jnp.int32),) if ragged else ()
 
     @functools.partial(
         shard_map,
@@ -225,16 +235,18 @@ def _tree_decode_common(
             (q_spec,)
             + (kv_spec,) * len(kv_arrays)
             + (rep_spec,) * len(rep_arrays)
+            + ((P(data_axis),) if ragged else ())
         ),
         out_specs=(q_spec, P(data_axis, head_axis, None)),
         check_vma=False,
     )
     def _sharded(q_l, *rest):
         kv_locals = rest[: len(kv_arrays)]
-        rep_locals = rest[len(kv_arrays):]
+        rep_locals = rest[len(kv_arrays): len(kv_arrays) + len(rep_arrays)]
+        q_pos = rest[-1] if ragged else q_position
         shard = lax.axis_index(seq_axis)
         out, lse = local_attn(
-            q_l, kv_locals, rep_locals, q_position, shard * Tk_local
+            q_l, kv_locals, rep_locals, q_pos, shard * Tk_local
         )
         num, den, m = _merge_across(out, lse, seq_axis, payload)
         return _finalize_merge(num, den, m, q.dtype)
@@ -255,7 +267,7 @@ def _tree_decode_common(
     with obs.span("tree_decode", cat="dispatch",
                   args=None if not obs.TRACER.active else
                   {"ctx": Tk_global, "shards": n_shards, "payload": payload}):
-        return _sharded(q, *kv_arrays, *rep_arrays)
+        return _sharded(q, *kv_arrays, *rep_arrays, *pos_args)
 
 
 def tree_decode(
@@ -280,7 +292,10 @@ def tree_decode(
       q: ``(B, Hq, Tq, D)``, replicated over ``seq_axis`` (Tq is typically 1).
       k, v: ``(B, Hkv, Tk_global, D)`` sharded along dim 2 over ``seq_axis``.
       q_position: global position of the first query row for causal masking;
-        defaults to ``Tk_global - Tq`` (queries are the newest tokens).
+        defaults to ``Tk_global - Tq`` (queries are the newest tokens). May
+        be a per-slot ``(B,)`` vector — the ragged-batch decode shape: each
+        batch row (cache slot) masks against its own offset on every shard
+        (sharded like the batch dim, so it composes with a data axis).
       data_axis / head_axis: optional extra mesh axes sharding batch / heads.
       merge_payload: merge-collective wire format (``"split"``/``"packed"``);
         ``None`` reads ``TREE_ATTN_MERGE_PAYLOAD`` at call time.
@@ -292,6 +307,81 @@ def tree_decode(
 
     def local_attn(q_l, kv_locals, _rep, q_pos, kv_off):
         k_l, v_l = kv_locals
+        if getattr(q_pos, "ndim", 0) == 1:
+            # Ragged batch: per-slot offsets against this shard's KV block.
+            if impl == "auto":
+                # Mirror flash_attention's auto gate: the kernels must be
+                # importable and not opted out of (the module-level
+                # _AUTO_PALLAS read — one read per process, shared with
+                # flash_decode so the single-device and mesh paths of one
+                # decode can never disagree) — otherwise the portable vmap
+                # fallback below serves. An EXPLICIT pallas impl skips the
+                # gate, like everywhere else (the import then fails
+                # loudly, not silently).
+                from tree_attention_tpu.ops import _pallas_available
+                from tree_attention_tpu.ops.decode import _AUTO_PALLAS
+
+                on_tpu_mesh = (
+                    mesh_platforms(mesh) == {"tpu"}
+                    and _AUTO_PALLAS
+                    and _pallas_available()
+                )
+            else:
+                on_tpu_mesh = impl in ("pallas", "pallas_decode")
+            if on_tpu_mesh:
+                # Both Pallas kernels take (B,) offsets natively (per-batch
+                # SMEM columns) — no vmap over pallas_call. An explicit
+                # impl is honored as given; "auto" picks by Tq like
+                # flash_decode's rule (decode-sized shapes want the
+                # group-packed kernel; prefill-sized the Q-tiled one).
+                # Resolve interpret from the mesh platform, not the
+                # default backend (same reasoning as tree_decode_q8:
+                # inside shard_map the arrays are tracers and the kernel's
+                # auto-detection would consult the wrong platform for an
+                # emulated mesh on a TPU-default host).
+                platforms = mesh_platforms(mesh)
+                interpret = (
+                    None if platforms is None or platforms == {"tpu"}
+                    else True
+                )
+                pick = impl
+                if pick == "auto":
+                    from tree_attention_tpu.ops.tuning import tpu_kernel_for
+
+                    pick = tpu_kernel_for(q_l.shape[2])
+                if pick == "pallas_decode":
+                    from tree_attention_tpu.ops.pallas_decode import (
+                        attention_pallas_decode,
+                    )
+
+                    kernel = attention_pallas_decode
+                else:
+                    from tree_attention_tpu.ops.pallas_attention import (
+                        attention_pallas_fwd,
+                    )
+
+                    kernel = attention_pallas_fwd
+                kw = {} if block_size is None else {"block_size": block_size}
+                return kernel(
+                    q_l, k_l, v_l, causal=causal, scale=scale,
+                    q_offset=q_pos, kv_offset=kv_off,
+                    interpret=interpret, **kw,
+                )
+
+            # Portable path: vmap the jnp impl over batch so each row
+            # masks at its own position (a fully-masked shard contributes
+            # the safe-softmax identity, so the merge is unchanged).
+            def per_slot(q_b, k_b, v_b, p_b):
+                o, l = flash_attention(
+                    q_b[None], k_b[None], v_b[None],
+                    causal=causal, scale=scale,
+                    q_offset=p_b, kv_offset=kv_off,
+                    impl="blockwise" if impl == "auto" else impl,
+                    block_size=block_size,
+                )
+                return o[0], l[0]
+
+            return jax.vmap(per_slot)(q_l, k_l, v_l, q_pos)
         return flash_attention(
             q_l, k_l, v_l,
             causal=causal, scale=scale,
@@ -331,7 +421,8 @@ def tree_decode_q8(
     ``seq_axis``; ``k_q``/``v_q`` int8, sharded along dim 2) with the
     per-channel scales ``(B, Hkv, 1, D)`` replicated across shards — scales
     are per channel, not per token, so a sequence shard changes nothing
-    about them. Each device runs a q8 flash-decode kernel over its shard;
+    about them. ``q_position`` may be a per-slot ``(B,)`` vector (ragged
+    batch); the q8 kernels take per-batch offsets natively. Each device runs a q8 flash-decode kernel over its shard;
     the lse it emits is of the *dequantized* logits, so the partials merge
     through exactly the same safe-softmax collective as the exact path.
     Halves the per-device KV stream — the decode step's entire cost —

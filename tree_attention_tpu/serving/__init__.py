@@ -1,0 +1,18 @@
+"""Continuous-batching serving: slot scheduler over the ragged decode stack.
+
+The decode stack serves one request shape (``models/decode.py``); this
+package serves *traffic*: a fixed batch of S cache slots, a request queue,
+and a tick loop that admits pending requests into free slots, runs ONE
+compiled decode step for every live slot, and retires/refills slots the
+moment a request finishes — static shapes throughout, so one compilation
+serves every mixture of request states (the per-slot ``(B,)`` cache lengths
+carry the raggedness as data, not shape).
+"""
+
+from tree_attention_tpu.serving.engine import (  # noqa: F401
+    Request,
+    RequestResult,
+    ServeReport,
+    SlotServer,
+    synthetic_trace,
+)

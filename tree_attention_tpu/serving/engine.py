@@ -1,0 +1,512 @@
+"""Slot-based continuous batching over the ragged decode stack.
+
+The lockstep :func:`~tree_attention_tpu.models.decode.generate` decodes one
+batch whose rows start, step and stop together — requests with different
+prompt lengths, arrival times or stop points cannot share it, so aggregate
+tokens/sec dies at real traffic. This engine holds a **fixed batch of S cache
+slots** (one :class:`~tree_attention_tpu.models.decode.KVCache` of batch S
+with per-slot lengths) plus a request queue, and runs a tick loop:
+
+1. **Admit** — every free slot takes the oldest pending request whose
+   arrival time has passed: the prompt is prefilled into a slot-shaped
+   side cache (one compile per padded prompt bucket) and inserted into the
+   slot's region of the batch cache (k/v rows, per-slot length, first
+   sampled token).
+2. **Step** — ONE compiled decode step advances every live slot: the
+   ragged ``forward_step`` writes each slot's new row at its own offset and
+   masks each slot's unwritten tail independently. Dead slots ride along
+   (static shapes) but their lengths are frozen and their tokens held, so
+   occupancy changes never recompile.
+3. **Retire** — a slot whose request hit EOS or its token budget frees
+   immediately and is refilled on the same tick.
+
+The slot lifecycle is therefore ``free -> (admit/prefill) -> live ->
+(EOS | budget) -> free``, and the one compiled step serves every mixture of
+slot states. Works on one device and on a sequence-sharded mesh (the cache
+is seq-sharded; per-slot offsets ride the tree merge unchanged).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from tree_attention_tpu import obs
+from tree_attention_tpu.models.decode import (
+    KVCache,
+    QuantKVCache,
+    _sample,
+    forward_step,
+    init_cache,
+    quantize_cache,
+)
+from tree_attention_tpu.models.transformer import Params, TransformerConfig
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("serving")
+
+# Serving observability. Occupancy/queue metrics are host-loop truths
+# (execution-true, not trace-time): the loop sets/observes them as slots
+# change hands; token/request counters count work the engine finished.
+_SLOTS_OCCUPIED = obs.gauge(
+    "serving_slots_occupied",
+    "live slots in the serving batch (set once per tick)",
+)
+_QUEUE_WAIT = obs.histogram(
+    "serving_queue_wait_seconds",
+    "wall seconds a request waited between becoming visible and admission",
+)
+_TOKENS = obs.counter(
+    "serving_tokens_total",
+    "tokens decoded for live slots by executed serving ticks",
+)
+_REQUESTS = obs.counter(
+    "serving_requests_total",
+    "requests the engine finished, by outcome",
+    labels=("outcome",),
+)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request for the serving loop.
+
+    ``arrival_tick`` is synthetic-trace time in decode ticks: the request
+    becomes visible to the scheduler once the loop's tick counter reaches
+    it (0 = already queued at start). ``eos_id`` stops generation early
+    when sampled (the EOS token is included in the output).
+    """
+
+    uid: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    arrival_tick: int = 0
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RequestResult:
+    uid: int
+    tokens: List[int]
+    prompt_len: int
+    arrival_tick: int
+    admit_tick: int
+    finish_tick: int
+    queue_wait_s: float
+    completion_s: float  # visible -> finished, wall seconds
+    outcome: str  # "eos" | "max_tokens"
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """One serve() run: per-request results plus aggregate accounting."""
+
+    results: List[RequestResult]
+    ticks: int
+    wall_s: float
+    tokens_generated: int
+    mean_occupancy: float  # live slots per executed decode tick
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens_generated / self.wall_s if self.wall_s > 0 else 0.0
+
+    def completion_percentiles(self) -> Dict[str, float]:
+        cs = sorted(r.completion_s for r in self.results)
+        if not cs:
+            return {"p50_s": 0.0, "p95_s": 0.0}
+        pick = lambda p: cs[min(len(cs) - 1, int(p * (len(cs) - 1) + 0.5))]
+        return {"p50_s": pick(0.50), "p95_s": pick(0.95)}
+
+    def as_dict(self) -> Dict[str, Any]:
+        waits = sorted(r.queue_wait_s for r in self.results)
+        return {
+            "requests": len(self.results),
+            "ticks": self.ticks,
+            "wall_s": round(self.wall_s, 4),
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_sec": round(self.tokens_per_sec, 1),
+            "mean_occupancy": round(self.mean_occupancy, 2),
+            "queue_wait_p50_s": round(waits[len(waits) // 2], 4) if waits else 0.0,
+            **{k: round(v, 4) for k, v in self.completion_percentiles().items()},
+        }
+
+
+def synthetic_trace(
+    n_requests: int,
+    *,
+    prompt_len: int = 32,
+    prompt_jitter: int = 0,
+    max_new_tokens: int = 16,
+    arrival_every: int = 0,
+    vocab_size: int = 256,
+    seed: int = 0,
+    eos_id: Optional[int] = None,
+) -> List[Request]:
+    """A reproducible request trace: random prompts, optional length jitter,
+    arrivals every ``arrival_every`` ticks (0 = all queued at start)."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n_requests):
+        lo = max(1, prompt_len - prompt_jitter)
+        hi = prompt_len + prompt_jitter
+        plen = int(rng.integers(lo, hi + 1))
+        reqs.append(Request(
+            uid=i,
+            prompt=rng.integers(0, vocab_size, size=plen).astype(np.int32),
+            max_new_tokens=max_new_tokens,
+            arrival_tick=i * arrival_every,
+            eos_id=eos_id,
+        ))
+    return reqs
+
+
+def _bucket(n: int, cap: int, floor: int = 8) -> int:
+    """Pad a prompt length up to a power-of-two bucket (bounded compiles:
+    one prefill program per bucket, not per distinct prompt length)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class SlotServer:
+    """Continuous-batching engine: S slots, a queue, one compiled step.
+
+    Args:
+      params / cfg: the model served.
+      slots: batch size S of the slot cache — the max concurrent requests.
+      cache_len: per-slot KV capacity; every admitted request needs
+        ``prompt_len + max_new_tokens <= cache_len``.
+      mesh (+ axis names): sequence-shard the slot cache over a mesh; the
+        ragged decode step runs the tree merge per tick.
+      quantize: serve from an int8 cache — each admit prefills exactly then
+        quantizes that slot's rows under its own frozen per-channel scales
+        (the quantize-after-prefill contract, per slot).
+      temperature / seed: sampling (0 = greedy, the deterministic default).
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: TransformerConfig,
+        *,
+        slots: int,
+        cache_len: int,
+        mesh: Optional[Mesh] = None,
+        quantize: bool = False,
+        quant_kernel: str = "q8q",
+        temperature: float = 0.0,
+        seed: int = 0,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.cache_len = cache_len
+        self.mesh = mesh
+        self.quantize = quantize
+        self.quant_kernel = quant_kernel
+        self.temperature = float(temperature)
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0 (0 = greedy)")
+        self._key = jax.random.PRNGKey(seed)
+
+        kw = {"mesh": mesh} if mesh is not None else {}
+        self._fs_kw = dict(kw)
+        # The per-request prefill runs on a B=1 mini cache, which cannot
+        # shard over a data axis (1 does not divide it) — and needs no
+        # data parallelism anyway; the batched per-tick step keeps the
+        # full mesh spec.
+        self._prefill_kw = (
+            dict(kw, data_axis=None) if mesh is not None else {}
+        )
+        cache: Union[KVCache, QuantKVCache] = init_cache(
+            cfg, slots, cache_len, **kw
+        )
+        if quantize:
+            cache = quantize_cache(cache)  # empty prefix -> fallback scales
+        self.cache = cache
+        self.tok = jnp.zeros((slots,), jnp.int32)
+
+        # Host mirror of slot state (the scheduler's view; device state is
+        # the cache + tok + the live mask shipped each tick).
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self._slot_tokens: List[List[int]] = [[] for _ in range(slots)]
+        self._slot_admit: List[Tuple[int, float]] = [(0, 0.0)] * slots
+
+        # jax.jit caches one executable per padded-prompt bucket shape,
+        # so a single jitted prefill serves every bucket (bounded
+        # compiles); note the jit caches are per INSTANCE (bound methods),
+        # so a fresh server recompiles — bench/serving.py warms the same
+        # server it times. The tick loop reassigns self.cache/self.tok
+        # from each call's outputs, so the old buffers are donated — the
+        # per-tick step updates the (L,S,Hkv,Tmax,D) cache in place
+        # instead of copying it (backends without donation just copy).
+        self._prefill = jax.jit(self._prefill_fn)
+        self._insert = jax.jit(self._insert_fn, donate_argnums=(0, 1))
+        self._step = jax.jit(self._step_fn, donate_argnums=(1, 2))
+
+    # -- compiled pieces --------------------------------------------------
+
+    def _sample(self, logits: jax.Array, key: jax.Array) -> jax.Array:
+        # The ONE sampling definition is models.decode._sample — the
+        # token-for-token parity contract with generate() depends on the
+        # engine never growing its own variant.
+        return _sample(logits, self.temperature, key)
+
+    def _prefill_fn(self, params, prompt, plen, key):
+        """Prefill one request into a fresh slot-shaped B=1 cache.
+
+        ``prompt`` is padded to its bucket; rows at positions >= plen are
+        pad garbage, so after the step they are zeroed — the inserted slot
+        (and, under ``quantize``, its frozen per-channel scales) is then
+        bit-identical to an unpadded prefill, and one compile serves the
+        whole bucket.
+        """
+        cfg = self.cfg
+        shape = (cfg.n_layers, 1, cfg.n_kv_heads, self.cache_len, cfg.d_head)
+        mini = KVCache(
+            k=jnp.zeros(shape, cfg.dtype),
+            v=jnp.zeros(shape, cfg.dtype),
+            length=jnp.zeros((1,), jnp.int32),
+        )
+        logits, mini = forward_step(params, prompt, mini, cfg,
+                                    **self._prefill_kw)
+        valid = (
+            jnp.arange(self.cache_len, dtype=jnp.int32) < plen
+        )[None, None, None, :, None]
+        k = jnp.where(valid, mini.k, 0)
+        v = jnp.where(valid, mini.v, 0)
+        last = lax.dynamic_index_in_dim(logits, plen - 1, axis=1,
+                                        keepdims=False)  # (1, V)
+        tok = self._sample(last, key)[0]
+        if self.quantize:
+            qc = quantize_cache(KVCache(k=k, v=v, length=mini.length))
+            return qc.k, qc.v, qc.k_scale, qc.v_scale, tok
+        return k, v, tok
+
+    def _insert_fn(self, cache, tok_vec, slot, payload, plen):
+        """Place a prefilled B=1 cache into slot ``slot`` of the batch cache
+        (k/v rows, per-slot length, first token) — one compile, any slot."""
+        if self.quantize:
+            k_new, v_new, ks_new, vs_new, first = payload
+        else:
+            k_new, v_new, first = payload
+        put = lambda buf, new: lax.dynamic_update_index_in_dim(
+            buf, new[:, 0], slot, axis=1
+        )
+        length = lax.dynamic_update_index_in_dim(
+            cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
+        )
+        if self.quantize:
+            new_cache = QuantKVCache(
+                k=put(cache.k, k_new), v=put(cache.v, v_new),
+                k_scale=put(cache.k_scale, ks_new),
+                v_scale=put(cache.v_scale, vs_new),
+                length=length,
+            )
+        else:
+            new_cache = KVCache(
+                k=put(cache.k, k_new), v=put(cache.v, v_new), length=length
+            )
+        tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot, axis=0)
+        return new_cache, tok_vec
+
+    def _step_fn(self, params, tok, cache, live, key):
+        """One decode tick for the whole batch: ragged forward_step, sample,
+        then freeze dead slots (length restored, token held) so occupancy
+        changes are data, not shape."""
+        kw = dict(self._fs_kw)
+        if self.quantize:
+            kw["quant_kernel"] = self.quant_kernel
+        logits, new_cache = forward_step(params, tok[:, None], cache,
+                                         self.cfg, **kw)
+        key, sub = jax.random.split(key)
+        nxt = self._sample(logits[:, -1], sub)
+        length = jnp.where(live, new_cache.length, cache.length)
+        new_cache = dataclasses.replace(new_cache, length=length)
+        nxt = jnp.where(live, nxt, tok)
+        return nxt, new_cache, key
+
+    # -- scheduler --------------------------------------------------------
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _validate(self, req: Request) -> None:
+        plen = len(req.prompt)
+        if plen < 1:
+            raise ValueError(f"request {req.uid}: empty prompt")
+        if req.max_new_tokens < 1:
+            # The prefill itself samples one token, so a zero budget
+            # is unservable — same contract as generate().
+            raise ValueError(
+                f"request {req.uid}: max_new_tokens must be >= 1, "
+                f"got {req.max_new_tokens}"
+            )
+        if plen + req.max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"request {req.uid}: prompt {plen} + max_new "
+                f"{req.max_new_tokens} exceeds slot capacity {self.cache_len}"
+            )
+
+    def _admit(self, req: Request, slot: int, tick: int,
+               visible_at: float) -> float:
+        # Queue wait ends the moment the scheduler takes the request —
+        # BEFORE its prefill runs (prefill, including a first-bucket jit
+        # compile, is service time, not queueing).
+        waited = max(time.monotonic() - visible_at, 0.0)
+        plen = len(req.prompt)
+        bucket = _bucket(plen, self.cache_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = np.asarray(req.prompt, np.int32)
+        self._key, sub = jax.random.split(self._key)
+        payload = self._prefill(self.params, jnp.asarray(padded),
+                                jnp.int32(plen), sub)
+        self.cache, self.tok = self._insert(
+            self.cache, self.tok, jnp.int32(slot), payload, plen
+        )
+        first = int(payload[-1])
+        self._slot_req[slot] = req
+        self._slot_tokens[slot] = [first]
+        self._slot_admit[slot] = (tick, visible_at)
+        if obs.REGISTRY.enabled:
+            _QUEUE_WAIT.observe(waited)
+            _TOKENS.inc()  # the prefill's first sampled token
+        return waited
+
+    def _retire(self, slot: int, tick: int, outcome: str,
+                results: List[RequestResult]) -> None:
+        req = self._slot_req[slot]
+        admit_tick, visible_at = self._slot_admit[slot]
+        now = time.monotonic()
+        results.append(RequestResult(
+            uid=req.uid,
+            tokens=list(self._slot_tokens[slot]),
+            prompt_len=len(req.prompt),
+            arrival_tick=req.arrival_tick,
+            admit_tick=admit_tick,
+            finish_tick=tick,
+            queue_wait_s=0.0,  # filled by serve() from its visible ledger
+            completion_s=max(now - visible_at, 0.0),
+            outcome=outcome,
+        ))
+        self._slot_req[slot] = None
+        self._slot_tokens[slot] = []
+        if obs.REGISTRY.enabled:
+            _REQUESTS.labels(outcome=outcome).inc()
+
+    def serve(self, requests: Sequence[Request],
+              max_ticks: Optional[int] = None) -> ServeReport:
+        """Run the tick loop until every request has finished.
+
+        Requests are admitted in arrival order (FIFO per arrival tick);
+        ``max_ticks`` bounds runaway loops (raises if work remains)."""
+        for r in requests:
+            self._validate(r)
+        pending = deque(sorted(requests, key=lambda r: (r.arrival_tick, r.uid)))
+        results: List[RequestResult] = []
+        visible_wall: Dict[int, float] = {}
+        wait_ledger: Dict[int, float] = {}
+        tick = 0
+        decode_ticks = 0
+        occupancy = 0
+        tokens = 0
+        t0 = time.monotonic()
+
+        while pending or any(r is not None for r in self._slot_req):
+            if max_ticks is not None and tick >= max_ticks:
+                raise RuntimeError(
+                    f"serve() exceeded max_ticks={max_ticks} with "
+                    f"{len(pending)} pending request(s)"
+                )
+            now = time.monotonic()
+            for r in pending:  # sorted by arrival_tick — stop at the future
+                if r.arrival_tick > tick:
+                    break
+                visible_wall.setdefault(r.uid, now)
+
+            # Admit: oldest visible request per free slot; a retire this
+            # tick already freed its slot, so refill happens immediately.
+            free = self._free_slots()
+            while free and pending and pending[0].arrival_tick <= tick:
+                req = pending.popleft()
+                slot = free.pop(0)
+                vis = visible_wall.setdefault(req.uid, now)
+                wait_ledger[req.uid] = self._admit(req, slot, tick, vis)
+                first = self._slot_tokens[slot][0]
+                if (req.eos_id is not None and first == req.eos_id):
+                    # The prefill's own sample already ended the request.
+                    self._retire(slot, tick, "eos", results)
+                    free.append(slot)
+                elif req.max_new_tokens <= 1:
+                    self._retire(slot, tick, "max_tokens", results)
+                    free.append(slot)
+
+            live_idx = [i for i, r in enumerate(self._slot_req)
+                        if r is not None]
+            if obs.REGISTRY.enabled:
+                _SLOTS_OCCUPIED.set(len(live_idx))
+            if not live_idx:
+                if not pending:
+                    # The admit phase retired everything it admitted
+                    # (max_new_tokens=1 / prefill-sampled EOS) and drained
+                    # the queue: done.
+                    break
+                # Nothing running: fast-forward trace time to the next
+                # arrival instead of spinning empty decode steps.
+                tick = max(tick + 1, min(r.arrival_tick for r in pending))
+                continue
+
+            live = np.zeros((self.slots,), bool)
+            live[live_idx] = True
+            self.tok, self.cache, self._key = self._step(
+                self.params, self.tok, self.cache, jnp.asarray(live),
+                self._key,
+            )
+            toks_host = np.asarray(self.tok)  # fence: per-tick host sync
+            decode_ticks += 1
+            occupancy += len(live_idx)
+
+            for i in live_idx:
+                req = self._slot_req[i]
+                tok_i = int(toks_host[i])
+                self._slot_tokens[i].append(tok_i)
+                tokens += 1
+                if obs.REGISTRY.enabled:
+                    _TOKENS.inc()
+                if req.eos_id is not None and tok_i == req.eos_id:
+                    self._retire(i, tick, "eos", results)
+                elif len(self._slot_tokens[i]) >= req.max_new_tokens:
+                    self._retire(i, tick, "max_tokens", results)
+            tick += 1
+
+        wall = time.monotonic() - t0
+        for res in results:
+            res.queue_wait_s = wait_ledger.get(res.uid, 0.0)
+        # Prefill-sampled first tokens count toward the total.
+        tokens += sum(1 for _ in results)
+        log.info(
+            "served %d request(s): %d tokens over %d decode tick(s), "
+            "%.1f tok/s, mean occupancy %.2f/%d",
+            len(results), tokens, decode_ticks,
+            tokens / wall if wall > 0 else 0.0,
+            occupancy / max(decode_ticks, 1), self.slots,
+        )
+        return ServeReport(
+            results=sorted(results, key=lambda r: r.uid),
+            ticks=tick,
+            wall_s=wall,
+            tokens_generated=tokens,
+            mean_occupancy=occupancy / max(decode_ticks, 1),
+        )

@@ -48,7 +48,7 @@ class RunConfig:
     dtype: str = "bfloat16"  # TPU-native half; reference used fp16 on CPU
 
     # Execution.
-    mode: str = "decode"  # decode | train | generate | bench
+    mode: str = "decode"  # decode | train | generate | bench | serve
     device: str = "auto"  # auto | tpu | cpu
     mesh: Optional[str] = None  # e.g. "seq=8" or "data=2,seq=2,model=2"
     n_virtual_cpu: int = 0  # >0: force N virtual CPU devices (tests/emulation)
@@ -76,6 +76,13 @@ class RunConfig:
     # Generate mode.
     temperature: float = 0.8
     max_new_tokens: int = 32
+
+    # Serve mode (continuous batching over a synthetic request trace).
+    slots: int = 8           # concurrent cache slots (max in-flight requests)
+    requests: int = 16       # synthetic trace length
+    prompt_len: int = 32     # base prompt length of the trace
+    prompt_jitter: int = 8   # +- jitter on prompt lengths (ragged prompts)
+    arrival_every: int = 0   # ticks between arrivals (0 = all queued at start)
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -129,7 +136,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
             f"{d.seq_len}-token context, {d.heads} heads × {d.head_dim})."
         ),
     )
-    p.add_argument("--mode", choices=["decode", "train", "generate", "bench"],
+    p.add_argument("--mode",
+                   choices=["decode", "train", "generate", "bench", "serve"],
                    default=d.mode)
     p.add_argument("--device", choices=["auto", "tpu", "cpu"], default=d.device)
     p.add_argument("--mesh", default=d.mesh, metavar="SPEC",
@@ -201,7 +209,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=d.temperature,
                    help="generate mode: sampling temperature (0 = greedy)")
     p.add_argument("--max-new-tokens", type=int, default=d.max_new_tokens,
-                   help="generate mode: number of tokens to sample")
+                   help="generate/serve mode: number of tokens to sample "
+                        "per request")
+    p.add_argument("--slots", type=int, default=d.slots,
+                   help="serve mode: concurrent cache slots — the fixed "
+                        "batch the continuous-batching engine decodes every "
+                        "tick; the cache is sized from the trace "
+                        "(max prompt + max-new-tokens, rounded to the "
+                        "mesh's seq-shard multiple)")
+    p.add_argument("--requests", type=int, default=d.requests,
+                   help="serve mode: synthetic request-trace length")
+    p.add_argument("--prompt-len", type=int, default=d.prompt_len,
+                   help="serve mode: base prompt length of the trace")
+    p.add_argument("--prompt-jitter", type=int, default=d.prompt_jitter,
+                   help="serve mode: +- jitter on prompt lengths (ragged "
+                        "prompts exercise per-slot cache offsets)")
+    p.add_argument("--arrival-every", type=int, default=d.arrival_every,
+                   help="serve mode: decode ticks between request arrivals "
+                        "(0 = the whole trace is queued at start)")
     p.add_argument("--host-data", action="store_true", default=d.host_data,
                    help="train mode: feed batches from the native prefetching "
                         "host pipeline instead of on-device RNG")
