@@ -611,6 +611,18 @@ def _serving_record():
     return bench_serving()
 
 
+def _serving_flood_record():
+    """Long-prompt flood (ISSUE 3): p95 inter-token latency with chunked
+    admission (prefill fused into the per-tick mixed step, Sarathi-style
+    token budget) vs legacy whole-prompt blocking admission, plus the
+    chain_slope-priced stall ratio of one whole prefill vs one mixed
+    chunk tick. CPU proxy; the stall structure transfers. See
+    tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_flood
+
+    return bench_serving_flood()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -820,6 +832,7 @@ def _run_suite() -> None:
     run("tree_vs_ring_cpu8", _tree_vs_ring_record)
     run("tree_vs_ring_decode_cpu8", _tree_vs_ring_decode_record)
     run("serving_continuous_batching", _serving_record)
+    run("serving_chunked_prefill_flood", _serving_flood_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -908,6 +921,14 @@ def _summarize_record(name, rec):
             out["trace_speedup_vs_sequential"] = (
                 trace["trace_speedup_vs_sequential"]
             )
+    if name == "serving_chunked_prefill_flood":
+        slope = rec.get("slope", {})
+        if "stall_ratio" in slope:
+            out["stall_ratio"] = slope["stall_ratio"]
+        trace = rec.get("trace", {})
+        for key in ("tbt_p95_improvement", "tokens_per_sec_ratio"):
+            if key in trace:
+                out[key] = trace[key]
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

@@ -1,13 +1,17 @@
-"""Ragged-batch decode + continuous-batching scheduler tests (ISSUE 2).
+"""Ragged-batch decode + continuous-batching scheduler tests (ISSUE 2/3).
 
-The three parity contracts of the ragged decode stack:
+The four parity contracts of the ragged decode stack:
 
 (a) **equal-length slots reproduce lockstep generate() token-for-token**
     (exact and quantized cache) — raggedness is a strict generalisation;
 (b) **mixed lengths match per-request single-stream decode** — no slot
     reads another slot's cache rows, ever;
 (c) **scheduler property**: a random admit/retire trace delivers every
-    request exactly its tokens, identical to its own single-stream run.
+    request exactly its tokens, identical to its own single-stream run;
+(d) **chunked admission == whole-prompt admission** (ISSUE 3): prefill
+    chunks fused into the per-tick mixed-Tq step — for chunk sizes that
+    do and do not divide the prompt, exact AND int8 (staged
+    quantize-at-final-chunk) — produce bit-identical tokens.
 
 Everything here is CPU-safe and fast-tier: plain jnp paths plus the Pallas
 kernels in interpret mode, shard_map only through ``parallel/compat``
@@ -411,6 +415,238 @@ def test_synthetic_trace_shape():
     assert [r.arrival_tick for r in trace] == [0, 2, 4, 6, 8]
     assert all(5 <= len(r.prompt) <= 11 for r in trace)
     assert all(r.max_new_tokens == 4 for r in trace)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 3: stall-free chunked prefill fused into the tick
+# ---------------------------------------------------------------------------
+
+
+def test_flash_decode_ragged_multitoken_chunk(params):
+    """The mixed-Tq contract's kernel floor: a (B,) q_position with Tq > 1
+    (a prefill chunk riding the tick) equals per-row scalar calls
+    bit-for-bit on the chunked path AND the Q-tiled Pallas kernel
+    (interpret) — each row's chunk attends at its own offset."""
+    from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
+
+    rng = np.random.default_rng(3)
+    B, Hq, Hkv, Tq, D, cap = 3, 4, 2, 8, 16, 128
+    q = jnp.asarray(rng.standard_normal((B, Hq, Tq, D), np.float32))
+    k = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    v = jnp.asarray(rng.standard_normal((B, Hkv, cap, D), np.float32))
+    pos = jnp.asarray([0, 41, cap - Tq], jnp.int32)
+    out, lse = flash_decode(q, k, v, q_position=pos, num_splits=4)
+    out_p, lse_p = attention_pallas_fwd(
+        q, k, v, causal=True, q_offset=pos, kv_offset=0,
+        block_size=32, interpret=True,
+    )
+    for i in range(B):
+        o_i, l_i = flash_decode(
+            q[i:i + 1], k[i:i + 1], v[i:i + 1],
+            q_position=int(pos[i]), num_splits=4,
+        )
+        np.testing.assert_array_equal(np.asarray(out[i]), np.asarray(o_i[0]))
+        np.testing.assert_array_equal(np.asarray(lse[i]), np.asarray(l_i[0]))
+        o_pi, l_pi = attention_pallas_fwd(
+            q[i:i + 1], k[i:i + 1], v[i:i + 1], causal=True,
+            q_offset=int(pos[i]), kv_offset=0, block_size=32, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out_p[i]),
+                                      np.asarray(o_pi[0]))
+        np.testing.assert_array_equal(np.asarray(lse_p[i]),
+                                      np.asarray(l_pi[0]))
+
+
+def test_mixed_tq_forward_step_masked_window(params):
+    """forward_step(n_tokens=...): a padded mixed step must leave the cache
+    bit-identical to exact per-slot steps — including the clamp case where
+    a near-capacity slot's Tq-row window straddles the buffer end, and the
+    inert case n == 0 (nothing written, length frozen)."""
+    import dataclasses
+
+    cap = 16
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (2, 16), 0,
+                                CFG.vocab_size)
+    # Slot 0 nearly full (14/16), slot 1 short (3/16).
+    ca = init_cache(CFG, 1, cap)
+    _, ca = forward_step(params, tokens[:1, :14], ca, CFG)
+    cb = init_cache(CFG, 1, cap)
+    _, cb = forward_step(params, tokens[1:, :3], cb, CFG)
+    mixed = dataclasses.replace(
+        ca,
+        k=jnp.concatenate([ca.k, cb.k], axis=1),
+        v=jnp.concatenate([ca.v, cb.v], axis=1),
+        length=jnp.concatenate([ca.length, cb.length]),
+    )
+    # A Tq=8 padded step: slot 0 consumes 2 rows (window 14..22 clamps to
+    # 8..16 — the shifted-write case), slot 1 consumes 0 (inert).
+    pad = jnp.zeros((2, 8), jnp.int32)
+    pad = pad.at[0, :2].set(tokens[0, 14:16])
+    logits, mixed = forward_step(
+        params, pad, mixed, CFG, n_tokens=jnp.asarray([2, 0], jnp.int32)
+    )
+    ref_l, ca2 = forward_step(params, tokens[:1, 14:16], ca, CFG)
+    np.testing.assert_array_equal(np.asarray(mixed.length), [16, 3])
+    np.testing.assert_array_equal(np.asarray(mixed.k[:, 0]),
+                                  np.asarray(ca2.k[:, 0]))
+    np.testing.assert_array_equal(np.asarray(mixed.v[:, 0]),
+                                  np.asarray(ca2.v[:, 0]))
+    # Inert slot: cache bytes untouched.
+    np.testing.assert_array_equal(np.asarray(mixed.k[:, 1]),
+                                  np.asarray(cb.k[:, 0]))
+    np.testing.assert_allclose(np.asarray(logits[0, 1]),
+                               np.asarray(ref_l[0, 1]), atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("chunk", [4, 5])  # 4 divides the 12-token prompt,
+                                           # 5 leaves a 2-token final chunk
+def test_chunked_equals_whole_admission_exact(params, chunk):
+    """The tentpole parity: chunked admission (prefill fused into the tick
+    at `chunk` tokens per slot per tick) is token-for-token identical to
+    legacy whole-prompt admission, for chunk sizes that do and do not
+    divide the prompt."""
+    B, Tp, n_new = 3, 12, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (B, Tp), 0,
+                                CFG.vocab_size)
+    whole = SlotServer(params, CFG, slots=B, cache_len=32,
+                       admission="whole")
+    ref = whole.serve(_as_requests(prompt, n_new))
+    chunked = SlotServer(params, CFG, slots=B, cache_len=32,
+                         admission="chunked", prefill_chunk=chunk,
+                         prefill_budget=chunk)
+    got = chunked.serve(_as_requests(prompt, n_new))
+    for a, b in zip(ref.results, got.results):
+        assert a.tokens == b.tokens, (a.uid, a.tokens, b.tokens)
+    # And both match lockstep generate() — the original contract.
+    lock = np.asarray(generate(params, prompt, n_new, CFG, cache_len=32))
+    np.testing.assert_array_equal(
+        np.stack([np.asarray(r.tokens) for r in got.results]), lock
+    )
+
+
+@pytest.mark.parametrize("chunk", [4, 5])
+def test_chunked_equals_whole_admission_quantized(params, chunk):
+    """Same parity through the int8 cache: the staged exact prefill +
+    quantize-at-final-chunk must reproduce the whole-prompt
+    quantize-after-prefill bit-for-bit (same rows, same frozen scales)."""
+    B, Tp, n_new = 2, 12, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(14), (B, Tp), 0,
+                                CFG.vocab_size)
+    whole = SlotServer(params, CFG, slots=B, cache_len=32,
+                       admission="whole", quantize=True)
+    ref = whole.serve(_as_requests(prompt, n_new))
+    chunked = SlotServer(params, CFG, slots=B, cache_len=32,
+                         admission="chunked", quantize=True,
+                         prefill_chunk=chunk, prefill_budget=chunk)
+    got = chunked.serve(_as_requests(prompt, n_new))
+    for a, b in zip(ref.results, got.results):
+        assert a.tokens == b.tokens, (a.uid, a.tokens, b.tokens)
+
+
+def test_mid_prefill_arrival(params):
+    """Requests arriving while another slot is mid-prefill are admitted
+    into free slots and everyone still matches single-stream decode — the
+    scheduler interleaves chunks and decode without cross-talk."""
+    rng = np.random.default_rng(15)
+    long_prompt = rng.integers(0, CFG.vocab_size, size=20).astype(np.int32)
+    reqs = [
+        Request(uid=0, prompt=long_prompt, max_new_tokens=4,
+                arrival_tick=0),
+        # Arrives while uid 0 is still chunking (20 tokens / chunk 4 = 5
+        # ticks of prefill).
+        Request(uid=1,
+                prompt=rng.integers(0, CFG.vocab_size, size=6).astype(
+                    np.int32),
+                max_new_tokens=5, arrival_tick=1),
+        Request(uid=2,
+                prompt=rng.integers(0, CFG.vocab_size, size=9).astype(
+                    np.int32),
+                max_new_tokens=3, arrival_tick=2),
+    ]
+    server = SlotServer(params, CFG, slots=2, cache_len=32,
+                        prefill_chunk=4, prefill_budget=4)
+    report = server.serve(reqs, max_ticks=300)
+    assert sorted(r.uid for r in report.results) == [0, 1, 2]
+    for res in report.results:
+        req = next(r for r in reqs if r.uid == res.uid)
+        assert res.tokens == _single_stream(
+            params, req.prompt, req.max_new_tokens, cache_len=32
+        ), f"request {res.uid} diverged under mid-prefill arrival"
+
+
+def test_eos_on_final_chunk(params):
+    """EOS sampled ON the final prefill chunk retires the slot before it
+    ever decodes: outcome 'eos', exactly one token out."""
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(16), (11,), 0, CFG.vocab_size)
+    )
+    first = _single_stream(params, prompt, 1, cache_len=32)[0]
+    server = SlotServer(params, CFG, slots=2, cache_len=32,
+                        prefill_chunk=4, prefill_budget=4)
+    report = server.serve([
+        Request(uid=0, prompt=prompt, max_new_tokens=6, eos_id=first)
+    ])
+    res = report.results[0]
+    assert res.outcome == "eos"
+    assert res.tokens == [first]
+
+
+def test_chunked_admission_mesh_parity(params):
+    """Chunked admission on a seq-sharded mesh (mixed-Tq step through the
+    tree merge, masked window writes on sharded buffers) reproduces the
+    single-device chunked tokens."""
+    mesh = cpu_mesh(2)
+    B, Tp, n_new = 2, 12, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(17), (B, Tp), 0,
+                                CFG.vocab_size)
+    kw = dict(slots=B, cache_len=32, prefill_chunk=5, prefill_budget=5)
+    ref = SlotServer(params, CFG, **kw).serve(_as_requests(prompt, n_new))
+    got = SlotServer(params, CFG, mesh=mesh, **kw).serve(
+        _as_requests(prompt, n_new)
+    )
+    for a, b in zip(ref.results, got.results):
+        assert a.tokens == b.tokens, (a.uid, a.tokens, b.tokens)
+
+
+def test_chunked_quantized_mesh_parity(params):
+    """The staged (quantized) chunked admission on a seq-sharded mesh:
+    staging, quantize-at-final-chunk, and insert all reshard correctly
+    and reproduce the single-device tokens."""
+    mesh = cpu_mesh(2)
+    prompt = jax.random.randint(jax.random.PRNGKey(19), (2, 12), 0,
+                                CFG.vocab_size)
+    kw = dict(slots=2, cache_len=32, quantize=True, prefill_chunk=5)
+    ref = SlotServer(params, CFG, **kw).serve(_as_requests(prompt, 5))
+    got = SlotServer(params, CFG, mesh=mesh, **kw).serve(
+        _as_requests(prompt, 5)
+    )
+    for a, b in zip(ref.results, got.results):
+        assert a.tokens == b.tokens, (a.uid, a.tokens, b.tokens)
+
+
+def test_prefill_chunk_metrics(params):
+    """serving_prefill_chunks_total counts scheduled chunks; TTFT/TBT
+    histograms record once the registry is armed."""
+    from tree_attention_tpu import obs
+
+    obs.enable()
+    try:
+        reg = obs.REGISTRY
+        chunks0 = reg.counter("serving_prefill_chunks_total").value()
+        server = SlotServer(params, CFG, slots=2, cache_len=32,
+                            prefill_chunk=4, prefill_budget=4)
+        prompt = jax.random.randint(jax.random.PRNGKey(18), (2, 10), 0,
+                                    CFG.vocab_size)
+        server.serve(_as_requests(prompt, 3))
+        # 10-token prompts at chunk 4 -> 3 chunks each.
+        assert reg.counter("serving_prefill_chunks_total").value() \
+            - chunks0 == 6
+        assert reg.histogram("serving_ttft_seconds")._value_payload()[
+            "count"] >= 2
+        assert reg.histogram("serving_tbt_seconds")._value_payload()[
+            "count"] >= 2
+    finally:
+        obs.disable()
 
 
 def test_serving_metrics_flow(params):

@@ -468,6 +468,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         raise SystemExit("--slots must be >= 1")
     if cfg.prompt_len - cfg.prompt_jitter < 1:
         raise SystemExit("--prompt-jitter must leave prompts >= 1 token")
+    if cfg.prefill_chunk < 1:
+        raise SystemExit("--prefill-chunk must be >= 1")
+    if cfg.prefill_budget is not None and cfg.prefill_budget < 1:
+        raise SystemExit("--prefill-budget must be >= 1")
     if cfg.kv_quant != "none" and cfg.impl not in ("auto", "pallas_decode"):
         raise SystemExit(
             f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
@@ -500,6 +504,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         quantize=cfg.kv_quant != "none",
         quant_kernel=cfg.resolved_quant_kernel() or "q8q",
         temperature=cfg.temperature, seed=cfg.seed + 2,
+        prefill_chunk=cfg.prefill_chunk,
+        prefill_budget=cfg.prefill_budget,
+        admission=cfg.admission,
     )
     from tree_attention_tpu.host_runtime import heartbeat
 
@@ -516,6 +523,8 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         "mode": "serve",
         "slots": cfg.slots,
         "cache_len": cache_len,
+        "admission": cfg.admission,
+        "prefill_chunk": cfg.prefill_chunk,
         **report.as_dict(),
         "outcomes": {
             o: sum(1 for r in report.results if r.outcome == o)

@@ -17,6 +17,11 @@ driver decodes one token against freshly random KV and discards the result
   one compilation serves every step AND every mixture of per-slot lengths —
   the property continuous batching (:mod:`tree_attention_tpu.serving`)
   is built on. Prefill is the same function with the prompt as one big step.
+  With ``n_tokens`` (a per-slot ``(B,)`` valid-count vector) the step goes
+  **mixed-Tq**: slot ``i`` consumes only its first ``n_tokens[i]`` rows of
+  the padded ``(B, Tq)`` token matrix — the shape a stall-free serving tick
+  needs, where decode slots (one token) and prefill chunks (up to ``Tq``
+  tokens) share ONE compiled program.
 - :func:`generate` — prefill + ``lax.scan`` of single-token steps, greedy or
   temperature sampling, donate-friendly (all slots in lockstep — the
   equal-lengths special case of the ragged machinery).
@@ -198,6 +203,34 @@ def init_cache(
     return KVCache(k=k, v=v, length=jnp.zeros((batch_size,), jnp.int32))
 
 
+def _masked_window_write(
+    buf: jax.Array, rows: jax.Array, start: jax.Array, n: jax.Array
+) -> jax.Array:
+    """Write ``rows[:, :n]`` into ``buf`` at token positions
+    ``[start, start + n)``, leaving every other buffer byte untouched.
+
+    One slot's piece of the mixed-Tq step (vmapped over batch): ``buf`` is
+    ``(Hkv, Tmax, D)``, ``rows`` ``(Hkv, Tq, D)``, ``start``/``n`` scalars
+    with ``start + n <= Tmax`` and ``Tq <= Tmax``. The window offset is
+    clamped to ``Tmax - Tq`` (a decode slot near capacity padded to a
+    chunk-sized Tq would otherwise clamp INSIDE dynamic_update_slice and
+    shift garbage over valid rows); the valid rows are shifted to
+    compensate, so they land at their true absolute positions and the
+    rest of the window is written back unchanged.
+    """
+    Tq = rows.shape[1]
+    cap = buf.shape[1]
+    ws = jnp.clip(start, 0, cap - Tq)
+    shift = start - ws  # > 0 only when the window straddles capacity
+    window = lax.dynamic_slice_in_dim(buf, ws, Tq, axis=1)
+    idx = jnp.arange(Tq, dtype=jnp.int32)
+    src = idx - shift  # new-row index that window position idx holds
+    gathered = jnp.take(rows, jnp.clip(src, 0, Tq - 1), axis=1)
+    keep = (src >= 0) & (src < n)
+    merged = jnp.where(keep[None, :, None], gathered, window)
+    return lax.dynamic_update_slice_in_dim(buf, merged, ws, axis=1)
+
+
 def forward_step(
     params: Params,
     tokens: jax.Array,
@@ -210,6 +243,7 @@ def forward_step(
     model_axis: Optional[str] = AXIS_MODEL,
     num_splits: Optional[int] = None,
     quant_kernel: str = "q8q",
+    n_tokens: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Union[KVCache, QuantKVCache]]:
     """Run ``Tq`` new tokens through the model against the cache.
 
@@ -219,10 +253,24 @@ def forward_step(
         need not agree (the ragged-batch shape continuous batching serves).
         ``Tq`` is the prompt length at prefill and 1 in the decode loop —
         both hit the same code path.
+      n_tokens: optional per-slot ``(B,)`` valid counts — the **mixed-Tq**
+        step a stall-free serving tick runs. Slot ``i`` consumes only its
+        first ``n_tokens[i]`` rows of the padded token matrix: exactly
+        those K/V rows are written (a masked read-modify-write window —
+        rows ``>= n_tokens[i]`` leave the cache untouched, so the buffer
+        stays bit-identical to a sequence of exact steps) and ``length``
+        advances by ``n_tokens[i]``, not ``Tq``. A slot with ``n == 0``
+        rides along inert (nothing written, length frozen). Logits rows at
+        ``>= n_tokens[i]`` are pad garbage the caller must ignore (sample
+        slot ``i`` from row ``n_tokens[i] - 1``). Values must satisfy
+        ``0 <= n_tokens[i]`` and ``length[i] + n_tokens[i] <= capacity``;
+        ``Tq`` itself must be ``<= capacity`` (the write window is
+        ``Tq`` rows).
 
     Returns:
       ``logits``: ``(B, Tq, vocab)`` float32; the updated cache
-      (``length += Tq``). With a :class:`QuantKVCache`, new rows quantize
+      (``length += Tq``, or ``+= n_tokens`` when given). With a
+      :class:`QuantKVCache`, new rows quantize
       under the cache's frozen scales and attention runs the q8 kernels —
       ``quant_kernel`` picks which (``"q8q"`` int8-MXU default, ``"q8"``
       bf16-cast; see :func:`decode_attention`), while ``cfg.attn_impl``
@@ -235,6 +283,13 @@ def forward_step(
 
     B, Tq = tokens.shape
     start = cache.length  # (B,) per-slot offsets
+    if n_tokens is not None and Tq > cache.capacity:
+        # The masked write is a Tq-row window into the token axis; a window
+        # wider than the buffer cannot be placed at any offset.
+        raise ValueError(
+            f"mixed-Tq step: Tq={Tq} exceeds cache capacity "
+            f"{cache.capacity}"
+        )
     if not isinstance(start, jax.core.Tracer):
         # Only checkable eagerly: under jit ``length`` is traced and an
         # overflowing write would silently clamp (dynamic_update_slice
@@ -246,11 +301,27 @@ def forward_step(
         # scanned step) and break the isinstance guard.
         import numpy as np
 
-        hi = int(np.max(np.asarray(start)))
-        if hi + Tq > cache.capacity:
+        if n_tokens is None:
+            hi = int(np.max(np.asarray(start))) + Tq
+        elif not isinstance(n_tokens, jax.core.Tracer):
+            # Mixed-Tq: each slot grows by its own count, so the overflow
+            # bound is per-slot, not max(length) + Tq. An out-of-range
+            # count is just as silent a corrupter: n > Tq advances length
+            # past the last written row (stale bytes become visible
+            # history), n < 0 rewinds it.
+            nt = np.asarray(n_tokens)
+            if int(np.min(nt)) < 0 or int(np.max(nt)) > Tq:
+                raise ValueError(
+                    f"mixed-Tq step: n_tokens must lie in [0, Tq={Tq}], "
+                    f"got range [{int(np.min(nt))}, {int(np.max(nt))}]"
+                )
+            hi = int(np.max(np.asarray(start) + nt))
+        else:
+            hi = None
+        if hi is not None and hi > cache.capacity:
             raise ValueError(
-                f"KV cache overflow: length {hi} + {Tq} new tokens "
-                f"exceeds capacity {cache.capacity}"
+                f"KV cache overflow: writes reach {hi} tokens, "
+                f"exceeding capacity {cache.capacity}"
             )
     positions = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)  # (B, Tq)
 
@@ -278,13 +349,30 @@ def forward_step(
         if quant:
             k_new = _quantize_rows(k_new, k_s)
             v_new = _quantize_rows(v_new, v_s)
-        write = jax.vmap(
-            lambda buf, rows, s: lax.dynamic_update_slice_in_dim(
-                buf, rows, s, axis=1
+        if n_tokens is None:
+            write = jax.vmap(
+                lambda buf, rows, s: lax.dynamic_update_slice_in_dim(
+                    buf, rows, s, axis=1
+                )
             )
-        )
-        k_cache = write(k_cache, k_new.astype(k_cache.dtype), start)
-        v_cache = write(v_cache, v_new.astype(v_cache.dtype), start)
+            k_cache = write(k_cache, k_new.astype(k_cache.dtype), start)
+            v_cache = write(v_cache, v_new.astype(v_cache.dtype), start)
+        else:
+            # Mixed-Tq masked write: only rows < n_tokens[i] may land. A
+            # plain Tq-row dynamic-update would (a) write pad garbage the
+            # causal mask has to hide until it is overwritten and (b)
+            # CLAMP near capacity (dynamic_update_slice semantics), sliding
+            # garbage over a decode slot's newest valid rows. Instead:
+            # read the Tq-row window at a clamped offset, overlay exactly
+            # the valid rows at their true absolute positions, write it
+            # back — cache bytes outside [start, start+n) are untouched.
+            write = jax.vmap(_masked_window_write, in_axes=(0, 0, 0, 0))
+            k_cache = write(
+                k_cache, k_new.astype(k_cache.dtype), start, n_tokens
+            )
+            v_cache = write(
+                v_cache, v_new.astype(v_cache.dtype), start, n_tokens
+            )
 
         attn_kw = dict(
             q_position=start,
@@ -314,13 +402,14 @@ def forward_step(
     x, (new_k, new_v) = lax.scan(body, x, xs)
     x = rms_norm(x, params["ln_f"], cfg.norm_eps)
     logits = (x @ params["wout"]).astype(jnp.float32)
+    grew = Tq if n_tokens is None else n_tokens
     if quant:
         new_cache = QuantKVCache(
             k=new_k, v=new_v, k_scale=cache.k_scale, v_scale=cache.v_scale,
-            length=start + Tq,
+            length=start + grew,
         )
     else:
-        new_cache = KVCache(k=new_k, v=new_v, length=start + Tq)
+        new_cache = KVCache(k=new_k, v=new_v, length=start + grew)
     return logits, new_cache
 
 

@@ -1,4 +1,4 @@
-"""Slot-based continuous batching over the ragged decode stack.
+"""Slot-based continuous batching with stall-free chunked prefill.
 
 The lockstep :func:`~tree_attention_tpu.models.decode.generate` decodes one
 batch whose rows start, step and stop together — requests with different
@@ -8,22 +8,46 @@ slots** (one :class:`~tree_attention_tpu.models.decode.KVCache` of batch S
 with per-slot lengths) plus a request queue, and runs a tick loop:
 
 1. **Admit** — every free slot takes the oldest pending request whose
-   arrival time has passed: the prompt is prefilled into a slot-shaped
-   side cache (one compile per padded prompt bucket) and inserted into the
-   slot's region of the batch cache (k/v rows, per-slot length, first
-   sampled token).
-2. **Step** — ONE compiled decode step advances every live slot: the
-   ragged ``forward_step`` writes each slot's new row at its own offset and
-   masks each slot's unwritten tail independently. Dead slots ride along
-   (static shapes) but their lengths are frozen and their tokens held, so
-   occupancy changes never recompile.
+   arrival time has passed; the slot enters the ``prefilling`` state with
+   nothing on the device yet.
+2. **Step** — ONE compiled **mixed** step advances the whole batch: every
+   live slot contributes its one decode token, and up to ``prefill_budget``
+   prompt tokens of the prefilling slots ride along as fixed-size chunks
+   (``prefill_chunk``), written **directly into each slot's region of the
+   batch cache** at that slot's running offset via the ragged mixed-Tq
+   ``forward_step`` (per-slot ``n_tokens``). No B=1 mini cache, no insert
+   copy, no per-admit host sync: a long prompt costs each live slot at most
+   one chunk of extra latency per tick instead of a whole-prompt stall —
+   the Sarathi-style stall-free batching shape (arXiv:2403.02310). Chunk
+   sizes come from a small fixed power-of-two bucket set, so occupancy
+   changes and chunk mixtures never recompile (pure-decode ticks reuse the
+   same program at Tq=1).
 3. **Retire** — a slot whose request hit EOS or its token budget frees
-   immediately and is refilled on the same tick.
+   immediately and is refilled on the next admission pass.
 
-The slot lifecycle is therefore ``free -> (admit/prefill) -> live ->
-(EOS | budget) -> free``, and the one compiled step serves every mixture of
-slot states. Works on one device and on a sequence-sharded mesh (the cache
-is seq-sharded; per-slot offsets ride the tree merge unchanged).
+The slot lifecycle is ``free -> prefilling -> live -> (EOS | budget) ->
+free``. The first sampled token is never fetched on its own: the final
+chunk's sample lands in the per-tick batched token fetch that the decode
+loop already pays (one host sync per tick, total).
+
+Variants:
+
+- ``admission="whole"`` keeps the legacy blocking path — the whole prompt
+  prefills into a prompt-bucket-sized B=1 mini cache and is inserted into
+  the slot in one shot. Its first sampled token ALSO rides the per-tick
+  fetch (the slot sits out one step while the token parks in the device
+  token vector).
+- ``quantize=True`` serves from an int8 cache. Chunked admission then runs
+  its chunks against ONE preallocated exact **staging** cache (int8 rows
+  cannot hold exact prefill activations), and at final-chunk completion the
+  staged prefix is masked, quantized under its own frozen per-channel
+  scales, and inserted — the quantize-after-prefill contract, per slot.
+  One prompt stages at a time; decode ticks never wait for more than a
+  chunk of prefill work either way.
+
+Works on one device and on a sequence-sharded mesh (the cache is
+seq-sharded; per-slot offsets and chunk windows ride the tree merge
+unchanged).
 """
 
 from __future__ import annotations
@@ -53,9 +77,10 @@ from tree_attention_tpu.utils.logging import get_logger
 
 log = get_logger("serving")
 
-# Serving observability. Occupancy/queue metrics are host-loop truths
-# (execution-true, not trace-time): the loop sets/observes them as slots
-# change hands; token/request counters count work the engine finished.
+# Serving observability. Occupancy/queue/latency metrics are host-loop
+# truths (execution-true, not trace-time): the loop sets/observes them as
+# slots change hands; token/request/chunk counters count work the engine
+# finished.
 _SLOTS_OCCUPIED = obs.gauge(
     "serving_slots_occupied",
     "live slots in the serving batch (set once per tick)",
@@ -72,6 +97,19 @@ _REQUESTS = obs.counter(
     "serving_requests_total",
     "requests the engine finished, by outcome",
     labels=("outcome",),
+)
+_PREFILL_CHUNKS = obs.counter(
+    "serving_prefill_chunks_total",
+    "prefill chunks scheduled into serving ticks (fused or staged)",
+)
+_TTFT = obs.histogram(
+    "serving_ttft_seconds",
+    "wall seconds from request visibility to its first sampled token",
+)
+_TBT = obs.histogram(
+    "serving_tbt_seconds",
+    "wall seconds between consecutive tokens of one live slot "
+    "(inter-token latency)",
 )
 
 
@@ -103,6 +141,15 @@ class RequestResult:
     queue_wait_s: float
     completion_s: float  # visible -> finished, wall seconds
     outcome: str  # "eos" | "max_tokens"
+    ttft_s: float = 0.0  # visible -> first sampled token, wall seconds
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[
+        min(len(sorted_vals) - 1, int(p * (len(sorted_vals) - 1) + 0.5))
+    ]
 
 
 @dataclasses.dataclass
@@ -114,6 +161,7 @@ class ServeReport:
     wall_s: float
     tokens_generated: int
     mean_occupancy: float  # live slots per executed decode tick
+    tbt_s: List[float] = dataclasses.field(default_factory=list)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -121,10 +169,20 @@ class ServeReport:
 
     def completion_percentiles(self) -> Dict[str, float]:
         cs = sorted(r.completion_s for r in self.results)
-        if not cs:
-            return {"p50_s": 0.0, "p95_s": 0.0}
-        pick = lambda p: cs[min(len(cs) - 1, int(p * (len(cs) - 1) + 0.5))]
-        return {"p50_s": pick(0.50), "p95_s": pick(0.95)}
+        return {"p50_s": _pct(cs, 0.50), "p95_s": _pct(cs, 0.95)}
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        """TTFT (visible -> first token) and inter-token latency (gap
+        between consecutive tokens of one slot, pooled over slots) — the
+        two serving latencies chunked prefill exists to protect."""
+        ttft = sorted(r.ttft_s for r in self.results)
+        tbt = sorted(self.tbt_s)
+        return {
+            "ttft_p50_s": _pct(ttft, 0.50),
+            "ttft_p95_s": _pct(ttft, 0.95),
+            "tbt_p50_s": _pct(tbt, 0.50),
+            "tbt_p95_s": _pct(tbt, 0.95),
+        }
 
     def as_dict(self) -> Dict[str, Any]:
         waits = sorted(r.queue_wait_s for r in self.results)
@@ -137,6 +195,7 @@ class ServeReport:
             "mean_occupancy": round(self.mean_occupancy, 2),
             "queue_wait_p50_s": round(waits[len(waits) // 2], 4) if waits else 0.0,
             **{k: round(v, 4) for k, v in self.completion_percentiles().items()},
+            **{k: round(v, 5) for k, v in self.latency_percentiles().items()},
         }
 
 
@@ -169,17 +228,20 @@ def synthetic_trace(
     return reqs
 
 
-def _bucket(n: int, cap: int, floor: int = 8) -> int:
+def _bucket(n: int, cap: int, floor: int = 8, multiple: int = 1) -> int:
     """Pad a prompt length up to a power-of-two bucket (bounded compiles:
-    one prefill program per bucket, not per distinct prompt length)."""
+    one prefill program per bucket, not per distinct prompt length),
+    rounded to ``multiple`` (a seq-sharded mini cache must divide over the
+    mesh) and capped at ``cap``."""
     b = floor
     while b < n:
         b *= 2
+    b = -(-b // max(multiple, 1)) * max(multiple, 1)
     return min(b, cap)
 
 
 class SlotServer:
-    """Continuous-batching engine: S slots, a queue, one compiled step.
+    """Continuous-batching engine: S slots, a queue, one compiled mixed step.
 
     Args:
       params / cfg: the model served.
@@ -188,10 +250,24 @@ class SlotServer:
         ``prompt_len + max_new_tokens <= cache_len``.
       mesh (+ axis names): sequence-shard the slot cache over a mesh; the
         ragged decode step runs the tree merge per tick.
-      quantize: serve from an int8 cache — each admit prefills exactly then
-        quantizes that slot's rows under its own frozen per-channel scales
-        (the quantize-after-prefill contract, per slot).
+      quantize: serve from an int8 cache — each request prefills exactly
+        (staged, under chunked admission) then quantizes that slot's rows
+        under its own frozen per-channel scales (the quantize-after-prefill
+        contract, per slot).
+      quant_kernel: which q8 kernel decode ticks run (``"q8q"`` / ``"q8"``).
       temperature / seed: sampling (0 = greedy, the deterministic default).
+      prefill_chunk: max prompt tokens one tick may write for one slot
+        (clamped to ``cache_len``). Smaller = lower inter-token latency
+        spikes for live slots, more ticks per prompt.
+      prefill_budget: max TOTAL prompt tokens per tick across prefilling
+        slots — the Sarathi-style token budget; live decode tokens always
+        ride for free. Default: ``slots * prefill_chunk`` (every
+        prefilling slot advances one chunk per tick). The padded mixed
+        program computes ``S x Tq`` rows whether one chunk rides or all
+        of them, so concurrent chunks cost no extra compute; a smaller
+        budget only bounds KV-write traffic per tick.
+      admission: ``"chunked"`` (default — stall-free, fused into the tick)
+        or ``"whole"`` (legacy blocking whole-prompt prefill + insert).
     """
 
     def __init__(
@@ -206,9 +282,24 @@ class SlotServer:
         quant_kernel: str = "q8q",
         temperature: float = 0.0,
         seed: int = 0,
+        prefill_chunk: int = 256,
+        prefill_budget: Optional[int] = None,
+        admission: str = "chunked",
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if admission not in ("chunked", "whole"):
+            raise ValueError(
+                f"admission must be 'chunked' or 'whole', got {admission!r}"
+            )
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        if prefill_budget is not None and prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1, got {prefill_budget}"
+            )
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -219,17 +310,28 @@ class SlotServer:
         self.temperature = float(temperature)
         if self.temperature < 0:
             raise ValueError("temperature must be >= 0 (0 = greedy)")
+        self.admission = admission
+        self.prefill_chunk = min(prefill_chunk, cache_len)
+        self.prefill_budget = (
+            slots * self.prefill_chunk if prefill_budget is None
+            else prefill_budget
+        )
         self._key = jax.random.PRNGKey(seed)
 
         kw = {"mesh": mesh} if mesh is not None else {}
         self._fs_kw = dict(kw)
-        # The per-request prefill runs on a B=1 mini cache, which cannot
-        # shard over a data axis (1 does not divide it) — and needs no
-        # data parallelism anyway; the batched per-tick step keeps the
-        # full mesh spec.
+        # B=1 programs (the legacy mini-cache prefill and the quantized
+        # staging cache) cannot shard over a data axis (1 does not divide
+        # it) — and need no data parallelism anyway; the batched per-tick
+        # step keeps the full mesh spec.
         self._prefill_kw = (
             dict(kw, data_axis=None) if mesh is not None else {}
         )
+        self._seq_shards = 1
+        if mesh is not None:
+            from tree_attention_tpu.parallel.mesh import AXIS_SEQ
+
+            self._seq_shards = max(mesh.shape.get(AXIS_SEQ, 1), 1)
         cache: Union[KVCache, QuantKVCache] = init_cache(
             cfg, slots, cache_len, **kw
         )
@@ -239,22 +341,51 @@ class SlotServer:
         self.tok = jnp.zeros((slots,), jnp.int32)
 
         # Host mirror of slot state (the scheduler's view; device state is
-        # the cache + tok + the live mask shipped each tick).
+        # the cache + the token vector the mixed step carries). States:
+        # "free", "prefill" (chunks in flight), "await" (first sampled
+        # token parked in the device token vector until this tick's
+        # batched fetch), "live" (decoding).
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._slot_tokens: List[List[int]] = [[] for _ in range(slots)]
         self._slot_admit: List[Tuple[int, float]] = [(0, 0.0)] * slots
+        self._slot_state: List[str] = ["free"] * slots
+        self._slot_ttft: List[float] = [0.0] * slots
+        self._prefill_pos: List[int] = [0] * slots
+        self._prompt_np: List[Optional[np.ndarray]] = [None] * slots
+        self._prefill_fifo: List[int] = []  # prefilling slots, admit order
+        self._last_tok_t: List[float] = [0.0] * slots
+        self._tok_host = np.zeros((slots,), np.int32)
 
-        # jax.jit caches one executable per padded-prompt bucket shape,
-        # so a single jitted prefill serves every bucket (bounded
-        # compiles); note the jit caches are per INSTANCE (bound methods),
-        # so a fresh server recompiles — bench/serving.py warms the same
-        # server it times. The tick loop reassigns self.cache/self.tok
-        # from each call's outputs, so the old buffers are donated — the
-        # per-tick step updates the (L,S,Hkv,Tmax,D) cache in place
-        # instead of copying it (backends without donation just copy).
+        # Quantized + chunked admission stages the exact prefill in ONE
+        # preallocated B=1 cache (int8 slots cannot hold exact chunk
+        # activations; allocating per admit is the cost this engine
+        # removes). One prompt stages at a time.
+        self._staged_prefill = quantize and admission == "chunked"
+        if self._staged_prefill:
+            self._staging: KVCache = init_cache(
+                cfg, 1, cache_len, **self._prefill_kw
+            )
+
+        # jax.jit caches one executable per Tq bucket for the mixed step
+        # (pure-decode ticks are the Tq=1 bucket, chunk ticks one of a
+        # small power-of-two set) and per prompt bucket for the legacy
+        # prefill — bounded compiles for every occupancy/chunk mixture.
+        # The jit caches are per INSTANCE (bound methods), so a fresh
+        # server recompiles — bench/serving.py warms the same server it
+        # times. The tick loop reassigns self.cache/self.tok from each
+        # call's outputs, so the old buffers are donated — each call
+        # updates the (L,S,Hkv,Tmax,D) cache in place instead of copying
+        # it (backends without donation just copy).
+        self._mixed = jax.jit(self._mixed_fn, donate_argnums=(5,))
         self._prefill = jax.jit(self._prefill_fn)
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0, 1))
-        self._step = jax.jit(self._step_fn, donate_argnums=(1, 2))
+        if self._staged_prefill:
+            self._stage_chunk = jax.jit(
+                self._stage_chunk_fn, donate_argnums=(3,)
+            )
+            self._stage_final = jax.jit(
+                self._stage_final_fn, donate_argnums=(3, 4, 5)
+            )
 
     # -- compiled pieces --------------------------------------------------
 
@@ -264,8 +395,48 @@ class SlotServer:
         # engine never growing its own variant.
         return _sample(logits, self.temperature, key)
 
+    def _chunk_bucket(self, n: int) -> int:
+        """Tq bucket for a chunk of ``n`` prompt tokens: power-of-two with
+        a floor of 8, capped at ``prefill_chunk`` — the small fixed set of
+        mixed-step programs."""
+        b = min(8, self.prefill_chunk)
+        while b < n:
+            b *= 2
+        return min(b, self.prefill_chunk)
+
+    def _mixed_fn(self, params, tokens, n_tok, reset, emit, cache, key):
+        """THE per-tick program: one mixed-Tq forward_step for every slot.
+
+        ``tokens`` is ``(S, Tq)`` (Tq = 1 on pure-decode ticks, a chunk
+        bucket otherwise); slot ``i`` consumes ``n_tok[i]`` rows — 1 for a
+        live decode slot, a chunk for a prefilling slot, 0 for everything
+        else (inert: nothing written, length frozen). ``reset`` zeroes a
+        slot's length before the write (a slot starting its first chunk
+        reuses a retired slot's region). Each slot samples from its own
+        last valid row; ``emit`` keeps the sample (decode slots and
+        final-chunk slots) or holds the slot's row-0 token (everything
+        else — in particular a parked first token rides through
+        unchanged).
+        """
+        length = jnp.where(reset, 0, cache.length)
+        cache = dataclasses.replace(cache, length=length)
+        kw = dict(self._fs_kw)
+        if self.quantize:
+            kw["quant_kernel"] = self.quant_kernel
+        logits, new_cache = forward_step(
+            params, tokens, cache, self.cfg, n_tokens=n_tok, **kw
+        )
+        key, sub = jax.random.split(key)
+        idx = jnp.maximum(n_tok - 1, 0)
+        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        nxt = self._sample(last, sub)
+        nxt = jnp.where(emit, nxt, tokens[:, 0])
+        return nxt, new_cache, key
+
     def _prefill_fn(self, params, prompt, plen, key):
-        """Prefill one request into a fresh slot-shaped B=1 cache.
+        """Legacy whole-prompt admission: prefill one request into a fresh
+        prompt-bucket-sized B=1 cache (NOT a full-capacity one — the
+        bucket bounds both the allocation and the attention work).
 
         ``prompt`` is padded to its bucket; rows at positions >= plen are
         pad garbage, so after the step they are zeroed — the inserted slot
@@ -274,7 +445,8 @@ class SlotServer:
         whole bucket.
         """
         cfg = self.cfg
-        shape = (cfg.n_layers, 1, cfg.n_kv_heads, self.cache_len, cfg.d_head)
+        bucket = prompt.shape[1]
+        shape = (cfg.n_layers, 1, cfg.n_kv_heads, bucket, cfg.d_head)
         mini = KVCache(
             k=jnp.zeros(shape, cfg.dtype),
             v=jnp.zeros(shape, cfg.dtype),
@@ -283,7 +455,7 @@ class SlotServer:
         logits, mini = forward_step(params, prompt, mini, cfg,
                                     **self._prefill_kw)
         valid = (
-            jnp.arange(self.cache_len, dtype=jnp.int32) < plen
+            jnp.arange(bucket, dtype=jnp.int32) < plen
         )[None, None, None, :, None]
         k = jnp.where(valid, mini.k, 0)
         v = jnp.where(valid, mini.v, 0)
@@ -296,14 +468,17 @@ class SlotServer:
         return k, v, tok
 
     def _insert_fn(self, cache, tok_vec, slot, payload, plen):
-        """Place a prefilled B=1 cache into slot ``slot`` of the batch cache
-        (k/v rows, per-slot length, first token) — one compile, any slot."""
+        """Place a bucket-sized prefilled B=1 cache into slot ``slot`` of
+        the batch cache (k/v rows, per-slot length, first token). The
+        slot's rows beyond the bucket keep stale bytes from the previous
+        occupant — every row >= the new length is masked future, and
+        decode overwrites them before they can become visible."""
         if self.quantize:
             k_new, v_new, ks_new, vs_new, first = payload
         else:
             k_new, v_new, first = payload
-        put = lambda buf, new: lax.dynamic_update_index_in_dim(
-            buf, new[:, 0], slot, axis=1
+        put = lambda buf, new: lax.dynamic_update_slice(
+            buf, new.astype(buf.dtype), (0, slot, 0, 0, 0)
         )
         length = lax.dynamic_update_index_in_dim(
             cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
@@ -322,26 +497,62 @@ class SlotServer:
         tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot, axis=0)
         return new_cache, tok_vec
 
-    def _step_fn(self, params, tok, cache, live, key):
-        """One decode tick for the whole batch: ragged forward_step, sample,
-        then freeze dead slots (length restored, token held) so occupancy
-        changes are data, not shape."""
-        kw = dict(self._fs_kw)
-        if self.quantize:
-            kw["quant_kernel"] = self.quant_kernel
-        logits, new_cache = forward_step(params, tok[:, None], cache,
-                                         self.cfg, **kw)
-        key, sub = jax.random.split(key)
-        nxt = self._sample(logits[:, -1], sub)
-        length = jnp.where(live, new_cache.length, cache.length)
-        new_cache = dataclasses.replace(new_cache, length=length)
-        nxt = jnp.where(live, nxt, tok)
-        return nxt, new_cache, key
+    def _stage_chunk_fn(self, params, tokens, n_tok, staging, reset):
+        """One mid-prompt chunk into the exact staging cache (quantized
+        chunked admission). Logits are unused here, so XLA prunes the
+        output head."""
+        length = jnp.where(reset, 0, staging.length)
+        staging = dataclasses.replace(staging, length=length)
+        _, staging = forward_step(
+            params, tokens, staging, self.cfg, n_tokens=n_tok,
+            **self._prefill_kw,
+        )
+        return staging
+
+    def _stage_final_fn(self, params, tokens, n_tok, staging, cache,
+                        tok_vec, slot, plen, reset, key):
+        """The final chunk: finish the staged exact prefill, sample the
+        first token from the last valid row, mask the stale tail, quantize
+        the staged prefix under its own frozen scales (the
+        quantize-after-prefill contract), and insert slot rows + scales +
+        length + first token into the batch cache — one dispatch, no host
+        sync (the token rides the per-tick fetch)."""
+        length = jnp.where(reset, 0, staging.length)
+        staging = dataclasses.replace(staging, length=length)
+        logits, staging = forward_step(
+            params, tokens, staging, self.cfg, n_tokens=n_tok,
+            **self._prefill_kw,
+        )
+        idx = jnp.maximum(n_tok - 1, 0)
+        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        first = self._sample(last, key)[0]
+        valid = (
+            jnp.arange(self.cache_len, dtype=jnp.int32) < plen
+        )[None, None, None, :, None]
+        qc = quantize_cache(KVCache(
+            k=jnp.where(valid, staging.k, 0),
+            v=jnp.where(valid, staging.v, 0),
+            length=staging.length,
+        ))
+        put = lambda buf, new: lax.dynamic_update_index_in_dim(
+            buf, new[:, 0], slot, axis=1
+        )
+        new_cache = QuantKVCache(
+            k=put(cache.k, qc.k), v=put(cache.v, qc.v),
+            k_scale=put(cache.k_scale, qc.k_scale),
+            v_scale=put(cache.v_scale, qc.v_scale),
+            length=lax.dynamic_update_index_in_dim(
+                cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
+            ),
+        )
+        tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot,
+                                                  axis=0)
+        return staging, new_cache, tok_vec
 
     # -- scheduler --------------------------------------------------------
 
     def _free_slots(self) -> List[int]:
-        return [i for i, r in enumerate(self._slot_req) if r is None]
+        return [i for i, st in enumerate(self._slot_state) if st == "free"]
 
     def _validate(self, req: Request) -> None:
         plen = len(req.prompt)
@@ -363,11 +574,32 @@ class SlotServer:
     def _admit(self, req: Request, slot: int, tick: int,
                visible_at: float) -> float:
         # Queue wait ends the moment the scheduler takes the request —
-        # BEFORE its prefill runs (prefill, including a first-bucket jit
-        # compile, is service time, not queueing).
+        # BEFORE any prefill work runs (prefill, including a first-bucket
+        # jit compile, is service time, not queueing).
         waited = max(time.monotonic() - visible_at, 0.0)
+        self._slot_req[slot] = req
+        self._slot_tokens[slot] = []
+        self._slot_admit[slot] = (tick, visible_at)
+        if self.admission == "chunked":
+            self._prompt_np[slot] = np.asarray(req.prompt, np.int32)
+            self._prefill_pos[slot] = 0
+            self._slot_state[slot] = "prefill"
+            self._prefill_fifo.append(slot)
+        else:
+            self._admit_whole(req, slot)
+            # First token parked in the device token vector; the slot sits
+            # out this tick's step (n=0 holds it) and goes live when the
+            # per-tick batched fetch reads it — no per-admit host sync.
+            self._slot_state[slot] = "await"
+        if obs.REGISTRY.enabled:
+            _QUEUE_WAIT.observe(waited)
+        return waited
+
+    def _admit_whole(self, req: Request, slot: int) -> None:
+        """Legacy blocking admission: whole-prompt prefill on a
+        bucket-sized mini cache, then insert into the slot's region."""
         plen = len(req.prompt)
-        bucket = _bucket(plen, self.cache_len)
+        bucket = _bucket(plen, self.cache_len, multiple=self._seq_shards)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :plen] = np.asarray(req.prompt, np.int32)
         self._key, sub = jax.random.split(self._key)
@@ -376,14 +608,63 @@ class SlotServer:
         self.cache, self.tok = self._insert(
             self.cache, self.tok, jnp.int32(slot), payload, plen
         )
-        first = int(payload[-1])
-        self._slot_req[slot] = req
-        self._slot_tokens[slot] = [first]
-        self._slot_admit[slot] = (tick, visible_at)
+
+    def _plan_chunks(self) -> List[Tuple[int, int, bool]]:
+        """Sarathi-style budget pass: FIFO over prefilling slots, each
+        taking up to a chunk, the tick taking at most ``prefill_budget``
+        prompt tokens total. Returns (slot, n, is_final) triples."""
+        plan: List[Tuple[int, int, bool]] = []
+        budget = self.prefill_budget
+        for slot in self._prefill_fifo:
+            if budget <= 0:
+                break
+            plen = len(self._slot_req[slot].prompt)
+            pos = self._prefill_pos[slot]
+            n = min(self.prefill_chunk, plen - pos, budget)
+            if n <= 0:
+                continue
+            budget -= n
+            plan.append((slot, n, pos + n == plen))
+        return plan
+
+    def _consume_chunk(self, slot: int, n: int,
+                       last: bool) -> Tuple[np.ndarray, bool]:
+        """Host-side bookkeeping of one scheduled chunk — the ONE copy the
+        fused and staged paths share: slice the prompt rows, advance the
+        slot's running position, and on the final chunk move the slot to
+        ``await`` (its first sampled token lands in this tick's batched
+        fetch). Returns the token rows and whether this chunk starts the
+        prompt (the slot's length must reset before the write)."""
+        pos = self._prefill_pos[slot]
+        rows = self._prompt_np[slot][pos:pos + n]
+        self._prefill_pos[slot] = pos + n
+        if last:
+            self._slot_state[slot] = "await"
+            self._prefill_fifo.remove(slot)
         if obs.REGISTRY.enabled:
-            _QUEUE_WAIT.observe(waited)
-            _TOKENS.inc()  # the prefill's first sampled token
-        return waited
+            _PREFILL_CHUNKS.inc()
+        return rows, pos == 0
+
+    def _run_staged_chunk(self, slot: int, n: int, last: bool) -> None:
+        """Quantized chunked admission: advance one slot's staged exact
+        prefill by ``n`` tokens; the final chunk quantizes + inserts."""
+        plen = len(self._slot_req[slot].prompt)
+        rows, first = self._consume_chunk(slot, n, last)
+        mat = np.zeros((1, self._chunk_bucket(n)), np.int32)
+        mat[0, :n] = rows
+        n_vec = jnp.asarray([n], jnp.int32)
+        reset = jnp.asarray([first])
+        if last:
+            self._key, sub = jax.random.split(self._key)
+            self._staging, self.cache, self.tok = self._stage_final(
+                self.params, jnp.asarray(mat), n_vec, self._staging,
+                self.cache, self.tok, jnp.int32(slot), jnp.int32(plen),
+                reset, sub,
+            )
+        else:
+            self._staging = self._stage_chunk(
+                self.params, jnp.asarray(mat), n_vec, self._staging, reset
+            )
 
     def _retire(self, slot: int, tick: int, outcome: str,
                 results: List[RequestResult]) -> None:
@@ -400,9 +681,12 @@ class SlotServer:
             queue_wait_s=0.0,  # filled by serve() from its visible ledger
             completion_s=max(now - visible_at, 0.0),
             outcome=outcome,
+            ttft_s=self._slot_ttft[slot],
         ))
         self._slot_req[slot] = None
         self._slot_tokens[slot] = []
+        self._slot_state[slot] = "free"
+        self._prompt_np[slot] = None
         if obs.REGISTRY.enabled:
             _REQUESTS.labels(outcome=outcome).inc()
 
@@ -418,13 +702,14 @@ class SlotServer:
         results: List[RequestResult] = []
         visible_wall: Dict[int, float] = {}
         wait_ledger: Dict[int, float] = {}
+        tbt: List[float] = []
         tick = 0
         decode_ticks = 0
         occupancy = 0
         tokens = 0
         t0 = time.monotonic()
 
-        while pending or any(r is not None for r in self._slot_req):
+        while pending or any(st != "free" for st in self._slot_state):
             if max_ticks is not None and tick >= max_ticks:
                 raise RuntimeError(
                     f"serve() exceeded max_ticks={max_ticks} with "
@@ -436,60 +721,128 @@ class SlotServer:
                     break
                 visible_wall.setdefault(r.uid, now)
 
-            # Admit: oldest visible request per free slot; a retire this
-            # tick already freed its slot, so refill happens immediately.
+            # Admit: oldest visible request per free slot. Chunked
+            # admission is pure bookkeeping (the chunks run inside the
+            # tick); the staged (quantized) variant holds one prompt in
+            # flight at a time, so admission waits for the stage.
             free = self._free_slots()
             while free and pending and pending[0].arrival_tick <= tick:
+                if self._staged_prefill and self._prefill_fifo:
+                    break
                 req = pending.popleft()
                 slot = free.pop(0)
                 vis = visible_wall.setdefault(req.uid, now)
                 wait_ledger[req.uid] = self._admit(req, slot, tick, vis)
-                first = self._slot_tokens[slot][0]
-                if (req.eos_id is not None and first == req.eos_id):
-                    # The prefill's own sample already ended the request.
-                    self._retire(slot, tick, "eos", results)
-                    free.append(slot)
-                elif req.max_new_tokens <= 1:
-                    self._retire(slot, tick, "max_tokens", results)
-                    free.append(slot)
 
-            live_idx = [i for i, r in enumerate(self._slot_req)
-                        if r is not None]
+            # Plan this tick's prefill chunks (chunked admission only).
+            plan = self._plan_chunks() if self.admission == "chunked" else []
+            ran_staged = False
+            if self._staged_prefill and plan:
+                for slot, n, last in plan:
+                    self._run_staged_chunk(slot, n, last)
+                plan = []
+                ran_staged = True
+
+            live_idx = [i for i, st in enumerate(self._slot_state)
+                        if st == "live"]
             if obs.REGISTRY.enabled:
                 _SLOTS_OCCUPIED.set(len(live_idx))
-            if not live_idx:
-                if not pending:
-                    # The admit phase retired everything it admitted
-                    # (max_new_tokens=1 / prefill-sampled EOS) and drained
-                    # the queue: done.
-                    break
+
+            stepped = False
+            if plan:
+                # The fused mixed tick: decode rows + prefill chunks in
+                # ONE compiled program; chunks write straight into each
+                # slot's region of the batch cache at its running offset.
+                tq = self._chunk_bucket(max(n for _, n, _ in plan))
+                mat = np.zeros((self.slots, tq), np.int32)
+                n_vec = np.zeros((self.slots,), np.int32)
+                reset = np.zeros((self.slots,), bool)
+                emit = np.zeros((self.slots,), bool)
+                for i in live_idx:
+                    mat[i, 0] = self._tok_host[i]
+                    n_vec[i] = 1
+                    emit[i] = True
+                for slot, n, last in plan:
+                    rows, first = self._consume_chunk(slot, n, last)
+                    mat[slot, :n] = rows
+                    n_vec[slot] = n
+                    reset[slot] = first
+                    emit[slot] = last
+                self.tok, self.cache, self._key = self._mixed(
+                    self.params, jnp.asarray(mat), jnp.asarray(n_vec),
+                    jnp.asarray(reset), jnp.asarray(emit), self.cache,
+                    self._key,
+                )
+                stepped = True
+            elif live_idx:
+                # Pure-decode tick: the SAME program at the Tq=1 bucket,
+                # tokens carried on device (awaiting slots hold their
+                # parked first token through n=0 / emit=False).
+                n_vec = np.zeros((self.slots,), np.int32)
+                emit = np.zeros((self.slots,), bool)
+                n_vec[live_idx] = 1
+                emit[live_idx] = True
+                self.tok, self.cache, self._key = self._mixed(
+                    self.params, self.tok[:, None], jnp.asarray(n_vec),
+                    jnp.zeros((self.slots,), bool), jnp.asarray(emit),
+                    self.cache, self._key,
+                )
+                stepped = True
+
+            awaits = [i for i, st in enumerate(self._slot_state)
+                      if st == "await"]
+            if awaits or live_idx:
+                # THE per-tick host sync: every new token of this tick —
+                # decode samples, fused final-chunk first tokens, legacy
+                # insert first tokens — in one batched fetch. Only ticks
+                # that produced a token pay it: a fused tick of nothing
+                # but mid-prompt chunks skips the fetch (like the staged
+                # path below), letting consecutive chunks pipeline in the
+                # dispatch queue. A live slot always enters its tick with
+                # a fresh ``_tok_host`` — it went live inside this block.
+                self._tok_host = np.asarray(self.tok)
+                now2 = time.monotonic()
+                if live_idx:
+                    decode_ticks += 1
+                    occupancy += len(live_idx)
+                for i in awaits:
+                    req = self._slot_req[i]
+                    first = int(self._tok_host[i])
+                    self._slot_tokens[i] = [first]
+                    self._slot_state[i] = "live"
+                    _, vis = self._slot_admit[i]
+                    self._slot_ttft[i] = max(now2 - vis, 0.0)
+                    self._last_tok_t[i] = now2
+                    if obs.REGISTRY.enabled:
+                        _TOKENS.inc()  # the prefill's first sampled token
+                        _TTFT.observe(self._slot_ttft[i])
+                    if req.eos_id is not None and first == req.eos_id:
+                        self._retire(i, tick, "eos", results)
+                    elif req.max_new_tokens <= 1:
+                        self._retire(i, tick, "max_tokens", results)
+                for i in live_idx:
+                    req = self._slot_req[i]
+                    tok_i = int(self._tok_host[i])
+                    self._slot_tokens[i].append(tok_i)
+                    tokens += 1
+                    tbt.append(max(now2 - self._last_tok_t[i], 0.0))
+                    self._last_tok_t[i] = now2
+                    if obs.REGISTRY.enabled:
+                        _TOKENS.inc()
+                        _TBT.observe(tbt[-1])
+                    if req.eos_id is not None and tok_i == req.eos_id:
+                        self._retire(i, tick, "eos", results)
+                    elif len(self._slot_tokens[i]) >= req.max_new_tokens:
+                        self._retire(i, tick, "max_tokens", results)
+                tick += 1
+            elif stepped or ran_staged:
+                tick += 1  # mid-prompt chunk tick: progress, no fetch
+            elif pending:
                 # Nothing running: fast-forward trace time to the next
                 # arrival instead of spinning empty decode steps.
                 tick = max(tick + 1, min(r.arrival_tick for r in pending))
-                continue
-
-            live = np.zeros((self.slots,), bool)
-            live[live_idx] = True
-            self.tok, self.cache, self._key = self._step(
-                self.params, self.tok, self.cache, jnp.asarray(live),
-                self._key,
-            )
-            toks_host = np.asarray(self.tok)  # fence: per-tick host sync
-            decode_ticks += 1
-            occupancy += len(live_idx)
-
-            for i in live_idx:
-                req = self._slot_req[i]
-                tok_i = int(toks_host[i])
-                self._slot_tokens[i].append(tok_i)
-                tokens += 1
-                if obs.REGISTRY.enabled:
-                    _TOKENS.inc()
-                if req.eos_id is not None and tok_i == req.eos_id:
-                    self._retire(i, tick, "eos", results)
-                elif len(self._slot_tokens[i]) >= req.max_new_tokens:
-                    self._retire(i, tick, "max_tokens", results)
-            tick += 1
+            else:
+                break  # admit phase drained everything without device work
 
         wall = time.monotonic() - t0
         for res in results:
@@ -509,4 +862,5 @@ class SlotServer:
             wall_s=wall,
             tokens_generated=tokens,
             mean_occupancy=occupancy / max(decode_ticks, 1),
+            tbt_s=tbt,
         )

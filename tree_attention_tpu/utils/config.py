@@ -83,6 +83,9 @@ class RunConfig:
     prompt_len: int = 32     # base prompt length of the trace
     prompt_jitter: int = 8   # +- jitter on prompt lengths (ragged prompts)
     arrival_every: int = 0   # ticks between arrivals (0 = all queued at start)
+    prefill_chunk: int = 256  # max prompt tokens one tick writes per slot
+    prefill_budget: Optional[int] = None  # per-tick prompt-token budget
+    admission: str = "chunked"  # "chunked" (stall-free) | "whole" (legacy)
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -227,6 +230,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--arrival-every", type=int, default=d.arrival_every,
                    help="serve mode: decode ticks between request arrivals "
                         "(0 = the whole trace is queued at start)")
+    p.add_argument("--prefill-chunk", type=int, default=d.prefill_chunk,
+                   help="serve mode: max prompt tokens one tick may write "
+                        "for one slot — smaller chunks bound the latency "
+                        "spike a long prompt inflicts on live slots")
+    p.add_argument("--prefill-budget", type=int, default=d.prefill_budget,
+                   help="serve mode: max TOTAL prompt tokens per tick "
+                        "across prefilling slots (default: slots * chunk, "
+                        "i.e. every prefilling slot advances one chunk) — "
+                        "the Sarathi-style stall-free token budget")
+    p.add_argument("--admission", choices=["chunked", "whole"],
+                   default=d.admission,
+                   help="serve mode: 'chunked' fuses prefill chunks into "
+                        "the per-tick mixed step (stall-free); 'whole' is "
+                        "the legacy blocking whole-prompt prefill + insert")
     p.add_argument("--host-data", action="store_true", default=d.host_data,
                    help="train mode: feed batches from the native prefetching "
                         "host pipeline instead of on-device RNG")
