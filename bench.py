@@ -749,9 +749,30 @@ def main() -> None:
     # writes in a finally: a crash (or Ctrl-C) after hours of records must
     # not lose the counters those records already filed.
     obs.configure()
+    http_server = None
+    port_env = os.environ.get("TA_METRICS_PORT")
+    if port_env:
+        # Live view of a multi-hour suite (this entry point has no flags
+        # by contract): curl /metrics while the records run. The ring must
+        # turn too — /healthz liveness and /flight read it (memory-only
+        # unless TA_FLIGHT_OUT also armed a dump sink).
+        from tree_attention_tpu.obs.http import MetricsHTTPServer
+
+        obs.REGISTRY.enable()
+        if not obs.FLIGHT.enabled:
+            obs.FLIGHT.arm()
+        http_server = MetricsHTTPServer(int(port_env))
+        print(f"# telemetry: http://127.0.0.1:{http_server.start()}/metrics",
+              file=sys.stderr)
+    if obs.REGISTRY.enabled or obs.TRACER.active or obs.FLIGHT.enabled:
+        # Crash-safe: a Ctrl-C / SIGTERM mid-suite still flushes the
+        # armed sinks (the finally below handles the clean paths).
+        obs.install_crash_handlers()
     try:
         _run_suite()
     finally:
+        if http_server is not None:
+            http_server.stop()
         obs.shutdown()
 
 
@@ -929,6 +950,10 @@ def _summarize_record(name, rec):
         for key in ("tbt_p95_improvement", "tokens_per_sec_ratio"):
             if key in trace:
                 out[key] = trace[key]
+        for mode in ("chunked", "whole"):
+            g = trace.get(mode, {}).get("goodput")
+            if g is not None:
+                out[f"goodput_{mode}"] = g
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

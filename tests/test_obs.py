@@ -11,18 +11,41 @@ Fast tier (no ``slow`` marker). Covers the ISSUE-1 contracts:
 - integration: a CPU decode CLI run with ``--metrics-out``/``--trace-events``
   emits nonzero token + collective-payload counters and well-formed trace
   events.
+
+And the ISSUE-4 serving-observability contracts:
+
+- ``Histogram.quantile`` monotone bucket interpolation + the shared
+  ``percentile`` definition;
+- flight-recorder ring semantics, dumps, and liveness age;
+- SLO window math vs oracle percentiles, window sliding, and goodput;
+- the live HTTP endpoints (``/metrics`` ``/metrics.json`` ``/healthz``
+  ``/flight``) against a real loopback server;
+- crash-safe telemetry: a SIGTERM'd process still flushes metrics, trace,
+  and flight-recorder sinks (subprocess test);
+- the disabled-path zero-allocation guard extended to the new hooks.
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
+import time
 import tracemalloc
+import urllib.error
+import urllib.request
 
 import pytest
 
-from tree_attention_tpu.obs.metrics import MetricsRegistry
+from tree_attention_tpu.obs.flight import FlightRecorder
+from tree_attention_tpu.obs.http import MetricsHTTPServer
+from tree_attention_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    percentile,
+)
+from tree_attention_tpu.obs.slo import SLOMonitor
 from tree_attention_tpu.obs.tracing import SpanTracer, _NOOP_SPAN
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -295,6 +318,387 @@ class TestTracer:
         tracer.instant("nothing")  # must not raise
 
 
+class TestPercentileAndQuantile:
+    """Satellite: one shared nearest-rank percentile + monotone bucket
+    interpolation on histograms (the SLO plane's two estimators)."""
+
+    def test_percentile_nearest_rank(self):
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        assert percentile(vals, 0.0) == 1.0
+        assert percentile(vals, 0.5) == 3.0
+        assert percentile(vals, 1.0) == 5.0
+        assert percentile(vals, 0.95) == 5.0
+        assert percentile([], 0.5) == 0.0
+        assert percentile([7.0], 0.99) == 7.0
+
+    def test_percentile_matches_serving_report_definition(self):
+        # The engine's old hand-rolled _pct was exactly this formula; the
+        # dedup must not shift any report's percentile.
+        vals = sorted([0.3, 0.1, 0.9, 0.5, 0.7, 0.2])
+        for p in (0.0, 0.25, 0.5, 0.9, 0.95, 1.0):
+            expect = vals[min(len(vals) - 1, int(p * (len(vals) - 1) + 0.5))]
+            assert percentile(vals, p) == expect
+
+    def test_quantile_interpolates_within_bucket(self):
+        reg = _enabled_registry()
+        h = reg.histogram("q_seconds", buckets=(1.0, 2.0, 4.0))
+        # 4 samples in (1, 2]: quantiles interpolate linearly across it.
+        for _ in range(4):
+            h.observe(1.5)
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(1.0) == pytest.approx(2.0)
+        assert h.quantile(0.25) == pytest.approx(1.25)
+
+    def test_quantile_monotone_across_buckets(self):
+        reg = _enabled_registry()
+        h = reg.histogram("q_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 5.0, 5.0):
+            h.observe(v)
+        qs = [h.quantile(p) for p in (0.0, 0.2, 0.4, 0.6, 0.8, 1.0)]
+        assert qs == sorted(qs)
+        # The first bucket (1 of 6 samples) interpolates from 0; the top
+        # stays finite.
+        assert 0.0 < h.quantile(0.1) <= 0.1
+        assert qs[-1] <= 10.0
+
+    def test_quantile_inf_bucket_clamps_to_highest_bound(self):
+        reg = _enabled_registry()
+        h = reg.histogram("q_seconds", buckets=(1.0, 2.0))
+        h.observe(100.0)  # lands in +Inf
+        assert h.quantile(0.99) == 2.0
+
+    def test_quantile_empty_and_bad_p(self):
+        reg = _enabled_registry()
+        h = reg.histogram("q_seconds", buckets=(1.0,))
+        assert h.quantile(0.5) == 0.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_quantile_labeled_parent_raises(self):
+        reg = _enabled_registry()
+        h = reg.histogram("q_seconds", labels=("x",), buckets=(1.0,))
+        with pytest.raises(ValueError):
+            h.quantile(0.5)
+        assert h.labels(x="a").quantile(0.5) == 0.0
+
+
+class TestFlightRecorder:
+    def test_disabled_record_is_noop(self):
+        fr = FlightRecorder(capacity=4)
+        fr.record({"tick": 0})
+        assert fr.ticks_recorded == 0
+        assert fr.last_tick_age() is None
+        assert fr.snapshot()["records"] == []
+
+    def test_ring_keeps_last_capacity_records_in_order(self):
+        fr = FlightRecorder(capacity=3)
+        fr.arm()
+        for i in range(7):
+            fr.record({"tick": i})
+        snap = fr.snapshot()
+        assert snap["ticks_recorded"] == 7
+        assert [r["tick"] for r in snap["records"]] == [4, 5, 6]
+        assert snap["capacity"] == 3
+        assert snap["last_tick_age_s"] is not None
+
+    def test_dump_writes_valid_json(self, tmp_path):
+        fr = FlightRecorder(capacity=8)
+        fr.arm()
+        fr.record({"tick": 0, "states": ["live"]})
+        path = tmp_path / "sub" / "flight.json"  # parent dir created
+        fr.dump(str(path), reason="test")
+        data = json.loads(path.read_text())
+        assert data["reason"] == "test"
+        assert data["records"] == [{"tick": 0, "states": ["live"]}]
+
+    def test_dump_if_armed_needs_a_sink(self, tmp_path):
+        fr = FlightRecorder()
+        fr.arm()  # memory-only
+        fr.record({"tick": 0})
+        assert fr.dump_if_armed("x") is None
+        path = str(tmp_path / "f.json")
+        fr.arm(path)
+        assert fr.dump_if_armed("err") == path
+        assert json.loads(open(path).read())["reason"] == "err"
+        fr.disarm()
+        assert fr.dump_if_armed("late") is None
+
+    def test_clear_resets_liveness(self):
+        fr = FlightRecorder()
+        fr.arm()
+        fr.record({"tick": 0})
+        fr.clear()
+        assert fr.ticks_recorded == 0
+        assert fr.last_tick_age() is None
+
+    def test_bad_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+
+class TestSLOMonitor:
+    def test_window_percentiles_match_oracle(self):
+        import random
+
+        rng = random.Random(3)
+        mon = SLOMonitor(ttft_slo=1.0, tbt_slo=0.1, window=64)
+        vals = [rng.uniform(0.0, 2.0) for _ in range(64)]
+        for v in vals:
+            mon.observe_ttft(v)
+            mon.observe_tbt(v)
+            mon.observe_queue_wait(v)
+        snap = mon.snapshot()
+        s = sorted(vals)
+        for p, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            oracle = percentile(s, p)
+            assert snap[f"ttft_{tag}_s"] == pytest.approx(oracle, abs=1e-6)
+            assert snap[f"tbt_{tag}_s"] == pytest.approx(oracle, abs=1e-6)
+            assert snap[f"queue_wait_{tag}_s"] == pytest.approx(
+                oracle, abs=1e-6)
+
+    def test_window_slides(self):
+        mon = SLOMonitor(window=4)
+        for v in (9.0, 9.0, 9.0, 1.0, 1.0, 1.0, 1.0):
+            mon.observe_ttft(v)
+        # Only the last 4 observations (all 1.0) remain visible.
+        assert mon.snapshot()["ttft_p99_s"] == 1.0
+
+    def test_goodput_verdicts(self):
+        mon = SLOMonitor(ttft_slo=1.0, tbt_slo=0.1, window=8)
+        assert mon.goodput() == 1.0  # idle server: not failing its SLO
+        assert mon.observe_request(0.5, 0.05) is True
+        assert mon.observe_request(2.0, 0.05) is False   # TTFT miss
+        assert mon.observe_request(0.5, 0.50) is False   # TBT miss
+        assert mon.observe_request(1.0, 0.1) is True     # inclusive bound
+        assert mon.goodput() == pytest.approx(0.5)
+        snap = mon.snapshot()
+        assert snap["goodput"] == pytest.approx(0.5)
+        assert snap["requests_in_window"] == 4
+        assert snap["requests_retired"] == 4
+
+    def test_goodput_window_slides(self):
+        mon = SLOMonitor(ttft_slo=1.0, tbt_slo=0.1, window=2)
+        mon.observe_request(9.0, 9.0)  # bad, slides out below
+        mon.observe_request(0.1, 0.01)
+        mon.observe_request(0.1, 0.01)
+        assert mon.goodput() == 1.0
+        assert mon.snapshot()["requests_retired"] == 3
+
+    def test_gauges_export_when_registry_enabled(self):
+        from tree_attention_tpu.obs import REGISTRY
+
+        mon = SLOMonitor(ttft_slo=1.0, tbt_slo=0.1, window=8)
+        mon.observe_ttft(0.25)
+        mon.observe_request(0.25, 0.0)
+        was = REGISTRY.enabled
+        REGISTRY.enable()
+        try:
+            mon.export_gauges()
+            g = REGISTRY.get("serving_slo_ttft_seconds")
+            assert g.labels(q="p50").value() == pytest.approx(0.25)
+            assert REGISTRY.get("serving_goodput_ratio").value() == 1.0
+            assert REGISTRY.get("serving_slo_window_requests").value() == 1
+        finally:
+            if not was:
+                REGISTRY.disable()
+
+    def test_lifetime_quantiles_from_histograms(self):
+        # Histogram.quantile reuse: snapshot carries run-lifetime TTFT/TBT
+        # quantiles interpolated from the cumulative histograms.
+        from tree_attention_tpu import obs
+        import tree_attention_tpu.serving.engine  # registers the hists
+
+        obs.enable()
+        try:
+            obs.REGISTRY.get("serving_ttft_seconds").observe(0.3)
+            snap = SLOMonitor().snapshot()
+            assert "ttft_lifetime_p50_s" in snap
+            assert snap["ttft_lifetime_p50_s"] > 0
+        finally:
+            obs.disable()
+
+    def test_bad_args_rejected(self):
+        with pytest.raises(ValueError):
+            SLOMonitor(ttft_slo=0.0)
+        with pytest.raises(ValueError):
+            SLOMonitor(tbt_slo=-1.0)
+        with pytest.raises(ValueError):
+            SLOMonitor(window=0)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, r.read().decode()
+
+
+class TestHTTPEndpoints:
+    """The live exporter against a real loopback server (port 0 = OS
+    pick), over a dedicated registry + flight recorder."""
+
+    @pytest.fixture()
+    def server(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("http_test_total", "h").inc(7)
+        reg.gauge("http_cap").set(4)
+        fr = FlightRecorder(capacity=4)
+        fr.arm()
+        srv = MetricsHTTPServer(
+            0, registry=reg, flight=fr, stall_after=30.0
+        )
+        srv.start()
+        yield srv, reg, fr
+        srv.stop()
+
+    def test_metrics_text_matches_registry(self, server):
+        srv, reg, _ = server
+        status, body = _get(srv.port, "/metrics")
+        assert status == 200
+        assert body == reg.to_prometheus()
+        assert "http_test_total 7" in body
+
+    def test_metrics_json_matches_snapshot(self, server):
+        srv, reg, _ = server
+        status, body = _get(srv.port, "/metrics.json")
+        assert status == 200
+        data = json.loads(body)
+        assert {m["name"] for m in data["metrics"]} == {
+            m["name"] for m in reg.snapshot()["metrics"]
+        }
+
+    def test_metrics_live_not_cached(self, server):
+        srv, reg, _ = server
+        reg.counter("http_test_total").inc(5)
+        _, body = _get(srv.port, "/metrics")
+        assert "http_test_total 12" in body
+
+    def test_healthz_idle_then_ok(self, server):
+        srv, _, fr = server
+        status, body = _get(srv.port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "idle"
+        fr.record({"tick": 0})
+        status, body = _get(srv.port, "/healthz")
+        body = json.loads(body)
+        assert status == 200 and body["status"] == "ok"
+        assert body["ticks_recorded"] == 1
+        assert body["last_tick_age_s"] < 30.0
+
+    def test_healthz_stalled_returns_503(self, server):
+        srv, _, fr = server
+        fr.record({"tick": 0})
+        fr._last_tick_t = time.monotonic() - 120.0
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/healthz")
+        assert err.value.code == 503
+        assert json.loads(err.value.read().decode())["status"] == "stalled"
+
+    def test_healthz_idle_again_after_drain(self, server):
+        """A drained serve() run (mark_idle) must not age into 'stalled' —
+        finished is not wedged, however old the last tick gets."""
+        srv, _, fr = server
+        fr.record({"tick": 0})
+        fr.mark_idle()
+        fr._last_tick_t = time.monotonic() - 120.0  # long past stall_after
+        status, body = _get(srv.port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "idle"
+
+    def test_flight_endpoint_serves_ring(self, server):
+        srv, _, fr = server
+        fr.record({"tick": 0, "occupancy": 2})
+        status, body = _get(srv.port, "/flight")
+        assert status == 200
+        data = json.loads(body)
+        assert data["records"] == [{"tick": 0, "occupancy": 2}]
+
+    def test_unknown_path_404(self, server):
+        srv, _, _ = server
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/nope")
+        assert err.value.code == 404
+
+    def test_index_lists_endpoints(self, server):
+        srv, _, _ = server
+        status, body = _get(srv.port, "/")
+        assert status == 200 and "/healthz" in body
+
+
+_CRASH_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from tree_attention_tpu import obs
+
+obs.configure(metrics_out={metrics!r}, trace_events={trace!r},
+              flight_out={flight!r})
+assert obs.install_crash_handlers()
+obs.counter("crash_test_total").inc(3)
+with obs.span("crash_phase"):
+    pass
+for i in range(5):
+    obs.FLIGHT.record({{"tick": i}})
+print("READY", flush=True)
+time.sleep(60)  # killed long before this returns
+"""
+
+
+def test_sigterm_flushes_all_sinks(tmp_path):
+    """Crash-safe telemetry (ISSUE-4 satellite): SIGTERM mid-run still
+    writes the metrics snapshot, flushes the span trace, and dumps the
+    flight ring — and the process still dies by SIGTERM."""
+    metrics = str(tmp_path / "m.json")
+    trace = str(tmp_path / "t.jsonl")
+    flight = str(tmp_path / "f.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CRASH_SCRIPT.format(
+            repo=REPO, metrics=metrics, trace=trace, flight=flight)],
+        stdout=subprocess.PIPE, text=True, cwd=str(tmp_path),
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        proc.kill()
+    assert rc == -signal.SIGTERM  # the kill stayed a kill
+    data = json.loads(open(metrics).read())
+    (c,) = [m for m in data["metrics"] if m["name"] == "crash_test_total"]
+    assert c["samples"][0]["value"] == 3
+    events = [json.loads(l) for l in open(trace).read().splitlines()]
+    assert any(e.get("name") == "crash_phase" for e in events)
+    fdata = json.loads(open(flight).read())
+    assert [r["tick"] for r in fdata["records"]] == [0, 1, 2, 3, 4]
+    assert fdata["reason"] == "flush"
+
+
+def test_sigusr1_dumps_and_keeps_running(tmp_path):
+    """SIGUSR1 is the live poke: dump the armed sinks, do NOT exit."""
+    flight = str(tmp_path / "f.json")
+    script = _CRASH_SCRIPT.format(
+        repo=REPO, metrics=None, trace=None, flight=flight,
+    ) + "\n"
+    # Replace the tail: after READY, wait for the dump then exit cleanly.
+    script = script.replace(
+        "time.sleep(60)  # killed long before this returns",
+        "t0 = time.time()\n"
+        "while not os.path.exists({flight!r}) and time.time() - t0 < 30:\n"
+        "    time.sleep(0.05)\n"
+        "print('DUMPED' if os.path.exists({flight!r}) else 'TIMEOUT',"
+        " flush=True)\n".format(flight=flight),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE, text=True, cwd=str(tmp_path),
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGUSR1)
+        assert proc.stdout.readline().strip() == "DUMPED"
+        assert proc.wait(timeout=30) == 0  # survived the signal
+    finally:
+        proc.kill()
+    assert json.loads(open(flight).read())["reason"] == "flush"
+
+
 class TestDisabledOverhead:
     """The hot-path guard: telemetry off must mean no-op AND no per-call
     allocation — the contract that lets heartbeat()/inc() sit on timing
@@ -307,6 +711,8 @@ class TestDisabledOverhead:
         g = reg.gauge("g")
         h = reg.histogram("h_seconds")
         tracer = SpanTracer()  # inactive
+        flight = FlightRecorder()  # disarmed
+        tick_rec = {"tick": 0}  # prebuilt, as the engine's guard requires
 
         def hot_path():
             c.inc()
@@ -316,6 +722,8 @@ class TestDisabledOverhead:
             with tracer.span("phase"):
                 pass
             tracer.instant("event")
+            flight.record(tick_rec)
+            flight.record(None)  # the disabled-guard calling shape
 
         hot_path()  # warm any lazy caches before measuring
         tracemalloc.start()

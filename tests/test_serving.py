@@ -19,6 +19,8 @@ kernels in interpret mode, shard_map only through ``parallel/compat``
 (see tests/conftest.py).
 """
 
+import json
+
 import numpy as np
 import pytest
 import jax
@@ -645,6 +647,189 @@ def test_prefill_chunk_metrics(params):
             "count"] >= 2
         assert reg.histogram("serving_tbt_seconds")._value_payload()[
             "count"] >= 2
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4: serving observability plane
+# ---------------------------------------------------------------------------
+
+
+def _traced_serve(params, tmp_path, reqs, **server_kw):
+    """Serve a trace with the span tracer armed; returns (report, events)."""
+    from tree_attention_tpu import obs
+
+    path = tmp_path / "serve_trace.jsonl"
+    obs.TRACER.start(str(path))
+    try:
+        server = SlotServer(params, CFG, **server_kw)
+        report = server.serve(reqs)
+    finally:
+        obs.TRACER.close()
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    return report, events
+
+
+def test_request_spans_rid_propagation(params, tmp_path):
+    """The tentpole trace contract: every request's life is one span plus
+    queued/admitted/first_token/retired instants, all carrying its rid —
+    loading the file shows each request from enqueue to retire."""
+    prompt = jax.random.randint(jax.random.PRNGKey(20), (3, 10), 0,
+                                CFG.vocab_size)
+    report, events = _traced_serve(
+        params, tmp_path, _as_requests(prompt, 4),
+        slots=2, cache_len=32, prefill_chunk=4, prefill_budget=4,
+    )
+    uids = {r.uid for r in report.results}
+
+    spans = [e for e in events if e["ph"] == "X"
+             and e["name"].startswith("request:")]
+    assert {e["args"]["rid"] for e in spans} == uids
+    for e in spans:
+        # Open at admit, closed at retire, outcome + token count tagged.
+        assert e["args"]["outcome"] == "max_tokens"
+        assert e["args"]["tokens"] == 4
+        assert e["args"]["ttft_s"] >= 0
+        assert e["dur"] > 0
+
+    def rids(name):
+        return [e["args"]["rid"] for e in events
+                if e["ph"] == "i" and e["name"] == name]
+
+    for name in ("request_queued", "request_admitted", "first_token",
+                 "request_retired"):
+        assert sorted(rids(name)) == sorted(uids), name
+    # Chunked admission: 10-token prompts at chunk 4 -> 3 chunks each,
+    # each instant tagged "k/N" with the owning rid.
+    chunks = [e for e in events if e["ph"] == "i"
+              and e["name"] == "prefill_chunk"]
+    assert len(chunks) == 3 * len(uids)
+    assert {c["args"]["rid"] for c in chunks} == uids
+    assert [c["args"]["chunk"] for c in chunks
+            if c["args"]["rid"] == min(uids)] == ["1/3", "2/3", "3/3"]
+
+
+def test_tick_spans_tag_occupancy_and_queue(params, tmp_path):
+    """Per-tick mixed-step spans carry occupancy, chunk-budget spent, and
+    queue depth — the three numbers a stall post-mortem starts from."""
+    prompt = jax.random.randint(jax.random.PRNGKey(21), (4, 8), 0,
+                                CFG.vocab_size)
+    report, events = _traced_serve(
+        params, tmp_path, _as_requests(prompt, 3),
+        slots=2, cache_len=32, prefill_chunk=4,
+    )
+    ticks = [e for e in events if e["ph"] == "X"
+             and e["name"] == "serving:tick"]
+    assert len(ticks) == report.ticks
+    for e in ticks:
+        args = e["args"]
+        assert {"tick", "occupancy", "prefilling", "chunk_tokens",
+                "queue_depth", "host_sync", "tokens"} <= set(args)
+        assert 0 <= args["occupancy"] <= 2
+    # 4 requests through 2 slots: early ticks see a nonzero queue.
+    assert any(e["args"]["queue_depth"] > 0 for e in ticks)
+    assert any(e["args"]["chunk_tokens"] > 0 for e in ticks)
+    assert sum(e["args"]["tokens"] for e in ticks) \
+        == report.tokens_generated
+
+
+def test_flight_recorder_records_serving_ticks(params):
+    """The engine feeds the ring one record per tick: occupancy vector,
+    slot states, chunk plan, host-sync flag, queue depth."""
+    from tree_attention_tpu.obs.flight import FLIGHT
+
+    prompt = jax.random.randint(jax.random.PRNGKey(22), (2, 9), 0,
+                                CFG.vocab_size)
+    FLIGHT.clear()
+    FLIGHT.arm()
+    try:
+        server = SlotServer(params, CFG, slots=2, cache_len=32,
+                            prefill_chunk=4)
+        report = server.serve(_as_requests(prompt, 3))
+    finally:
+        FLIGHT.disarm()
+    snap = FLIGHT.snapshot()
+    assert snap["ticks_recorded"] == report.ticks
+    recs = snap["records"]
+    assert [r["tick"] for r in recs] == sorted(r["tick"] for r in recs)
+    assert {"states", "chunk_plan", "tokens_emitted", "host_sync",
+            "queue_depth", "occupancy", "t_s"} <= set(recs[0])
+    # Chunk ticks then live decode then drained.
+    assert any(r["chunk_tokens"] > 0 for r in recs)
+    assert any(r["occupancy"] == 2 for r in recs)
+    assert sum(r["tokens_emitted"] for r in recs) == report.tokens_generated
+    FLIGHT.clear()
+
+
+def test_flight_dump_on_engine_error(params, tmp_path):
+    """An engine error (here: the max_ticks runaway guard) dumps the ring
+    to the armed sink before the exception propagates — the black box."""
+    from tree_attention_tpu.obs.flight import FLIGHT
+
+    path = tmp_path / "flight_err.json"
+    prompt = jax.random.randint(jax.random.PRNGKey(23), (2, 8), 0,
+                                CFG.vocab_size)
+    FLIGHT.clear()
+    FLIGHT.arm(str(path))
+    try:
+        server = SlotServer(params, CFG, slots=1, cache_len=32)
+        with pytest.raises(RuntimeError, match="max_ticks"):
+            server.serve(_as_requests(prompt, 8), max_ticks=3)
+    finally:
+        FLIGHT.disarm()
+    data = json.loads(path.read_text())
+    assert data["reason"] == "engine_error:RuntimeError"
+    assert data["records"], "no ticks captured before the error"
+    FLIGHT.clear()
+
+
+def test_serve_report_slo_goodput_bounds(params):
+    """SLO surface in ServeReport: generous targets -> goodput 1.0,
+    unmeetable targets -> 0.0; window percentiles agree with the report's
+    own TTFT/TBT accounting (same shared percentile definition)."""
+    prompt = jax.random.randint(jax.random.PRNGKey(24), (2, 8), 0,
+                                CFG.vocab_size)
+
+    relaxed = SlotServer(params, CFG, slots=2, cache_len=32,
+                         slo_ttft=3600.0, slo_tbt=3600.0)
+    rep = relaxed.serve(_as_requests(prompt, 3))
+    assert rep.slo["goodput"] == 1.0
+    assert rep.slo["requests_retired"] == 2
+    assert rep.slo["ttft_p95_s"] == pytest.approx(
+        rep.latency_percentiles()["ttft_p95_s"], abs=1e-6  # 6-dp rounding
+    )
+
+    strict = SlotServer(params, CFG, slots=2, cache_len=32,
+                        slo_ttft=1e-12, slo_tbt=1e-12)
+    rep = strict.serve(_as_requests(prompt, 3))
+    assert rep.slo["goodput"] == 0.0
+    assert rep.as_dict()["slo"]["slo"] == {"ttft_s": 1e-12, "tbt_s": 1e-12}
+
+
+def test_slo_gauges_live_after_serve(params):
+    """serve() publishes the windowed SLO gauges when the registry is
+    armed — what a /metrics scrape sees."""
+    from tree_attention_tpu import obs
+
+    obs.enable()
+    try:
+        server = SlotServer(params, CFG, slots=2, cache_len=32,
+                            slo_ttft=3600.0, slo_tbt=3600.0)
+        prompt = jax.random.randint(jax.random.PRNGKey(25), (2, 8), 0,
+                                    CFG.vocab_size)
+        server.serve(_as_requests(prompt, 3))
+        reg = obs.REGISTRY
+        assert reg.get("serving_goodput_ratio").value() == 1.0
+        assert reg.get("serving_slo_ttft_seconds").labels(
+            q="p95").value() > 0
+        assert reg.get("serving_slo_tbt_seconds").labels(
+            q="p50").value() >= 0
+        # And the Prometheus text a /metrics scrape would serve carries
+        # the series.
+        text = reg.to_prometheus()
+        assert 'serving_slo_ttft_seconds{q="p95"}' in text
+        assert "serving_goodput_ratio 1" in text
     finally:
         obs.disable()
 

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Compare two bench runs record-by-record; exit nonzero on regression.
+
+``bench.py`` emits one JSON suite per round (``BENCH_r{N}.json``) and the
+serving/flood records carry repeats — but nothing *guarded* the series:
+a PR could halve a throughput and no tool would say so. This closes that
+gap with the same noise discipline the records themselves use:
+
+- **min-over-repeats**: when a compared value is a list of numbers (slope
+  cycles, per-repeat latencies), the comparison takes the *best* sample —
+  min for smaller-is-better families, max for larger-is-better — because
+  the best-over-repeats is the noise-robust estimate of the true cost
+  (the slope protocol's rule; see utils/profiling.py).
+- **relative tolerance per metric family**: timings on this host carry
+  run-to-run jitter (the verify skill documents 15%+ spreads under
+  contention), so time-like metrics regress only past ``--rtol-time``
+  (default 0.30) and throughput/ratio-like metrics only past
+  ``--rtol-throughput`` (default 0.20). Counts and exact values
+  (collective counts, bytes-on-wire, dispatch totals) use ``--rtol-exact``
+  (default 0: any change is reported — those are compiled-HLO facts, not
+  measurements).
+
+Metric families are classified by key name:
+
+- smaller-is-better: ``*_us``, ``us_per_*``, ``*_s`` / ``*_seconds``
+  (incl. percentile keys like ``tbt_p95_s``), ``median``, ``wall_s``;
+- larger-is-better: ``*tokens_per_sec*``, ``*flops_per_sec*``,
+  ``*speedup*``, ``*improvement*``, ``stall_ratio``, ``goodput*``,
+  ``roofline_frac``;
+- exact: ``*_total``, ``*_bytes``, ``*_count``, ``n_*`` collective
+  counts;
+- anything else (strings, configs, workload echoes) is ignored.
+
+Usage:
+    python tools/bench_compare.py BASELINE.json CANDIDATE.json
+    python tools/bench_compare.py old.jsonl new.jsonl --rtol-time 0.4
+    python tools/bench_compare.py a.json b.json --only serving
+
+Inputs may be a bench suite (one JSON object), a single record, or JSONL
+(one record per line; records are keyed by their ``bench``/``name`` field
+or line number). Records present on only one side are listed but are not
+regressions (suites grow). Exit: 0 clean, 1 regression(s), 2 bad input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+SMALLER_IS_BETTER = "time"
+LARGER_IS_BETTER = "throughput"
+EXACT = "exact"
+
+_LARGER_SUBSTRINGS = (
+    "tokens_per_sec", "flops_per_sec", "speedup", "improvement",
+    "goodput", "roofline_frac", "stall_ratio",
+)
+_EXACT_SUFFIXES = ("_total", "_bytes", "_count")
+_SMALLER_SUFFIXES = ("_us", "_s", "_seconds", "_ms")
+_SMALLER_EXACT_KEYS = ("median", "mean", "wall_s", "p50", "p95", "p99")
+
+# Keys that LOOK numeric but are workload configuration, not measurement.
+_IGNORE_KEYS = frozenset((
+    "seed", "iters", "warmup", "repeats", "slots", "requests", "ticks",
+    "prompt_len", "prompt_jitter", "max_new_tokens", "arrival_every",
+    "prefill_chunk", "prompt_bucket", "cache_len", "window",
+    "spread_pct", "ratio_spread_pct", "slope_spread_pct",
+))
+
+
+def classify(key: str) -> Optional[str]:
+    """Metric family of a leaf key, or None to skip it."""
+    k = key.lower()
+    if k in _IGNORE_KEYS:
+        return None
+    if any(s in k for s in _LARGER_SUBSTRINGS):
+        return LARGER_IS_BETTER
+    if k.endswith(_EXACT_SUFFIXES) or k.startswith("n_"):
+        return EXACT
+    if k.endswith(_SMALLER_SUFFIXES) or k.startswith("us_per") \
+            or any(k == e or k.endswith("_" + e) for e in _SMALLER_EXACT_KEYS):
+        return SMALLER_IS_BETTER
+    return None
+
+
+def _best(value: Any, family: str) -> Optional[float]:
+    """Scalar for comparison; lists take the noise-robust best sample."""
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, list) and value \
+            and all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                    for v in value):
+        return float(min(value) if family == SMALLER_IS_BETTER
+                     else max(value))
+    return None
+
+
+def walk(rec: Any, prefix: str = "") -> Iterator[Tuple[str, str, float]]:
+    """(path, family, comparable-value) leaves of one record."""
+    if not isinstance(rec, dict):
+        return
+    for key, value in rec.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if isinstance(value, dict):
+            yield from walk(value, path)
+            continue
+        family = classify(key)
+        if family is None:
+            continue
+        v = _best(value, family)
+        if v is not None:
+            yield path, family, v
+
+
+def _unwrap(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Descend the known wrappers around a suite: the round driver's
+    ``BENCH_r{N}.json`` is ``{..., parsed: {..., records: {...}}}``; a
+    bench stdout line (and each ``measurements/*.jsonl`` line) wraps the
+    suite as ``{metric, value, ..., suite: {...}}``."""
+    for key in ("parsed", "records", "suite"):
+        inner = data.get(key)
+        if isinstance(inner, dict):
+            return _unwrap(inner)
+    return data
+
+
+def load_records(path: str) -> Dict[str, Any]:
+    """{record-name: record} from a suite JSON, single record, or JSONL.
+
+    JSONL: lines carrying a ``suite`` (bench stdout captures) merge their
+    records, later lines winning — comparing two capture logs compares
+    each record's final state; other lines key by ``bench``/``name``."""
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".jsonl"):
+        out: Dict[str, Any] = {}
+        for i, line in enumerate(filter(None, map(str.strip,
+                                                  text.splitlines()))):
+            rec = json.loads(line)
+            if isinstance(rec.get("suite"), dict):
+                out.update(_unwrap(rec))
+                continue
+            name = rec.get("bench") or rec.get("name") or f"line{i}"
+            out[str(name)] = rec
+        return out
+    data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object or .jsonl")
+    data = _unwrap(data)
+    # A bench suite maps names to record dicts; a single record has
+    # scalar/list leaves at top level too — treat it as one record then.
+    if data and all(isinstance(v, dict) for v in data.values()):
+        return data
+    return {"record": data}
+
+
+def compare(
+    base: Dict[str, Any],
+    cand: Dict[str, Any],
+    *,
+    rtol_time: float,
+    rtol_throughput: float,
+    rtol_exact: float,
+    only: Optional[str] = None,
+) -> Tuple[List[str], List[str]]:
+    """Returns (regressions, notes) — human-readable lines."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    tol = {
+        SMALLER_IS_BETTER: rtol_time,
+        LARGER_IS_BETTER: rtol_throughput,
+        EXACT: rtol_exact,
+    }
+    names = sorted(set(base) | set(cand))
+    for name in names:
+        if only and only not in name:
+            continue
+        if name not in cand:
+            notes.append(f"record {name!r}: only in baseline (dropped?)")
+            continue
+        if name not in base:
+            notes.append(f"record {name!r}: new in candidate")
+            continue
+        if "error" in cand[name] and "error" not in base.get(name, {}):
+            regressions.append(
+                f"{name}: candidate errored: {cand[name]['error']}"
+            )
+            continue
+        b_leaves = dict((p, (f, v)) for p, f, v in walk(base[name]))
+        c_leaves = dict((p, (f, v)) for p, f, v in walk(cand[name]))
+        for path in sorted(set(b_leaves) & set(c_leaves)):
+            family, bv = b_leaves[path]
+            _, cv = c_leaves[path]
+            if bv == cv:
+                continue
+            if family == EXACT:
+                denom = abs(bv) if bv else 1.0
+                if abs(cv - bv) / denom > tol[EXACT]:
+                    regressions.append(
+                        f"{name}.{path}: exact value changed "
+                        f"{bv:g} -> {cv:g}"
+                    )
+                continue
+            if bv == 0:
+                continue  # nothing to be relative to
+            rel = (cv - bv) / abs(bv)
+            worse = rel > tol[family] if family == SMALLER_IS_BETTER \
+                else rel < -tol[family]
+            if worse:
+                direction = "slower" if family == SMALLER_IS_BETTER \
+                    else "lower"
+                regressions.append(
+                    f"{name}.{path}: {bv:g} -> {cv:g} "
+                    f"({abs(rel) * 100:.1f}% {direction}, "
+                    f"tol {tol[family] * 100:.0f}%)"
+                )
+    return regressions, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="older bench JSON/JSONL")
+    ap.add_argument("candidate", help="newer bench JSON/JSONL")
+    ap.add_argument("--rtol-time", type=float, default=0.30,
+                    help="relative tolerance for smaller-is-better "
+                         "timings (default 0.30)")
+    ap.add_argument("--rtol-throughput", type=float, default=0.20,
+                    help="relative tolerance for larger-is-better "
+                         "throughputs/ratios (default 0.20)")
+    ap.add_argument("--rtol-exact", type=float, default=0.0,
+                    help="relative tolerance for exact counts/bytes "
+                         "(default 0: any change reported)")
+    ap.add_argument("--only", default=None, metavar="SUBSTR",
+                    help="compare only records whose name contains SUBSTR")
+    args = ap.parse_args(argv)
+
+    try:
+        base = load_records(args.baseline)
+        cand = load_records(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+
+    regressions, notes = compare(
+        base, cand,
+        rtol_time=args.rtol_time,
+        rtol_throughput=args.rtol_throughput,
+        rtol_exact=args.rtol_exact,
+        only=args.only,
+    )
+    for n in notes:
+        print(f"note: {n}")
+    if regressions:
+        print(f"bench_compare: {len(regressions)} regression(s) "
+              f"({args.baseline} -> {args.candidate}):")
+        for r in regressions:
+            print(f"  REGRESSION {r}")
+        return 1
+    print(f"bench_compare: OK ({args.baseline} -> {args.candidate}, "
+          f"{len(set(base) & set(cand))} shared record(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -444,14 +444,28 @@ def bench_serving_flood(
     }
 
     # --- trace: the real engine, per admission mode ---
+    # The SLO monitor turns the same runs into a goodput comparison —
+    # chunked admission's whole pitch is SLO attainment under flood, so
+    # the record carries it. CPU-proxy-sized targets, measured on this
+    # box: whole-admission worst gaps reach ~18-30 ms when a request's
+    # life overlaps a flood prefill, chunked stays <= ~6 ms — 10 ms sits
+    # between the two populations, so goodput separates the modes the
+    # way p95 TBT does (the *ratio* is the transferable part, like every
+    # flood number; absolute goodput on a contended box is noise).
+    slo_kw = dict(slo_ttft=2.0, slo_tbt=0.01)
+
     def run_mode(admission: str) -> Dict[str, Any]:
         server = SlotServer(
             params, cfg, slots=slots, cache_len=cache_len,
-            prefill_chunk=prefill_chunk, admission=admission,
+            prefill_chunk=prefill_chunk, admission=admission, **slo_kw,
         )
         server.serve(_flood_trace(**trace_kw))  # warmup: pays the compiles
         runs = []
         for _ in range(repeats):
+            # Each repeat's goodput is ITS run's verdicts: the window is
+            # larger than one flood, so without a reset the warmup's
+            # compile-stalled requests would depress every repeat.
+            server.slo.reset()
             report = server.serve(_flood_trace(**trace_kw))
             runs.append(report.as_dict())
         return {
@@ -460,6 +474,10 @@ def bench_serving_flood(
             "tbt_p50_s": min(r["tbt_p50_s"] for r in runs),
             "ttft_p95_s": min(r["ttft_p95_s"] for r in runs),
             "tokens_per_sec": max(r["tokens_per_sec"] for r in runs),
+            # Best-over-repeats, same noise discipline as the latencies.
+            "goodput": max(
+                r.get("slo", {}).get("goodput", 0.0) for r in runs
+            ),
         }
 
     trace_rec: Dict[str, Any] = {}
@@ -475,6 +493,7 @@ def bench_serving_flood(
         trace_rec["tokens_per_sec_ratio"] = round(
             trace_rec["chunked"]["tokens_per_sec"] / whole_tps, 3
         )
+    trace_rec["goodput_slo"] = slo_kw
 
     log.info(
         "flood: stall ratio %(sr).1fx (slope); trace p95 TBT %(w).4fs "

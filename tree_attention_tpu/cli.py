@@ -74,8 +74,11 @@ def _relaunch(cfg: RunConfig, argv: Optional[list]) -> int:
         if skip:
             skip = False
             continue
+        # --metrics-port is parent-only too: N children binding one port
+        # would race; the parent keeps the live endpoint.
         parent_only = (
-            "--launch", "--launch-timeout", "--heartbeat-stall", "--restarts"
+            "--launch", "--launch-timeout", "--heartbeat-stall",
+            "--restarts", "--metrics-port",
         )
         if a in parent_only:
             skip = True
@@ -498,6 +501,8 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         vocab_size=tcfg.vocab_size,
         seed=cfg.seed + 1,
     )
+    if cfg.slo_ttft <= 0 or cfg.slo_tbt <= 0:
+        raise SystemExit("--slo-ttft and --slo-tbt must be > 0")
     server = SlotServer(
         params, tcfg,
         slots=cfg.slots, cache_len=cache_len, mesh=mesh,
@@ -507,6 +512,8 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         prefill_chunk=cfg.prefill_chunk,
         prefill_budget=cfg.prefill_budget,
         admission=cfg.admission,
+        slo_ttft=cfg.slo_ttft,
+        slo_tbt=cfg.slo_tbt,
     )
     from tree_attention_tpu.host_runtime import heartbeat
 
@@ -535,6 +542,29 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     return 0
 
 
+def _start_metrics_http(cfg: RunConfig):
+    """Start the live telemetry endpoint, or return None without the flag.
+
+    /metrics needs the registry recording and /healthz + /flight need the
+    ring armed even when no exit sinks were asked for (a memory-only ring
+    serves both).
+    """
+    if cfg.metrics_port is None:
+        return None
+    obs.REGISTRY.enable()
+    if not obs.FLIGHT.enabled:
+        obs.FLIGHT.arm()
+    from tree_attention_tpu.obs.http import MetricsHTTPServer
+
+    server = MetricsHTTPServer(cfg.metrics_port)
+    port = server.start()
+    log.info(
+        "telemetry endpoint: http://127.0.0.1:%d/metrics "
+        "(/metrics.json /healthz /flight)", port,
+    )
+    return server
+
+
 def main(argv: Optional[list] = None) -> int:
     cfg = parse_args(argv)
     # Under --launch, every child would otherwise open (and rotate) the same
@@ -547,6 +577,7 @@ def main(argv: Optional[list] = None) -> int:
         log_file=log_file,
         all_processes=cfg.all_processes,
     )
+    http_server = None
     try:
         if cfg.launch > 1:
             # The parent records launcher metrics; children re-run main()
@@ -554,6 +585,11 @@ def main(argv: Optional[list] = None) -> int:
             obs.configure(
                 metrics_out=cfg.metrics_out, trace_events=cfg.trace_events
             )
+            # The parent serves the live endpoint (--metrics-port is
+            # stripped from children): its launcher/heartbeat metrics are
+            # the multi-process run's live view.
+            http_server = _start_metrics_http(cfg)
+            obs.install_crash_handlers()
             return _relaunch(cfg, argv)
         _configure_backend(cfg)
 
@@ -568,8 +604,15 @@ def main(argv: Optional[list] = None) -> int:
         # auto-detected multi-host runs neither TA_COORDINATOR nor
         # JAX_PROCESS_INDEX exists in the environment.
         obs.configure(
-            metrics_out=cfg.metrics_out, trace_events=cfg.trace_events
+            metrics_out=cfg.metrics_out, trace_events=cfg.trace_events,
+            flight_out=cfg.flight_out,
         )
+        http_server = _start_metrics_http(cfg)
+        if (obs.REGISTRY.enabled or obs.TRACER.active
+                or obs.FLIGHT.enabled):
+            # An interrupted run still flushes its sinks (atexit +
+            # SIGTERM; SIGUSR1 dumps the flight ring and keeps running).
+            obs.install_crash_handlers()
         log.info(
             "backend=%s devices=%d mesh=%s mode=%s",
             jax.default_backend(), jax.device_count(), cfg.mesh or "none",
@@ -589,12 +632,17 @@ def main(argv: Optional[list] = None) -> int:
         ):
             return runner(cfg, mesh)
     finally:
+        if http_server is not None:
+            http_server.stop()
         sinks = obs.shutdown()
-        if sinks["metrics_out"] or sinks["trace_events"]:
-            # The exit snapshot contract of --metrics-out / --trace-events.
+        if sinks["metrics_out"] or sinks["trace_events"] \
+                or sinks["flight_out"]:
+            # The exit snapshot contract of --metrics-out /
+            # --trace-events / --flight-out.
             log.info(
-                "telemetry: metrics=%s trace=%s",
+                "telemetry: metrics=%s trace=%s flight=%s",
                 sinks["metrics_out"] or "-", sinks["trace_events"] or "-",
+                sinks["flight_out"] or "-",
             )
 
 

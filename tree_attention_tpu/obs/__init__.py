@@ -10,6 +10,14 @@ Two complementary instruments, both off (and free) by default:
   alongside ``jax.profiler`` device traces; ``pid`` is the JAX process
   index so multi-host captures merge cleanly.
 
+The serving observability plane builds on both: the tick flight recorder
+(:mod:`~tree_attention_tpu.obs.flight`, ``--flight-out``), the
+sliding-window SLO monitor (:mod:`~tree_attention_tpu.obs.slo`), and the
+live HTTP exporter (:mod:`~tree_attention_tpu.obs.http`,
+``--metrics-port`` — imported lazily; mounting ``/metrics`` must not tax
+every library import). :func:`install_crash_handlers` makes all sinks
+crash-safe (atexit + SIGTERM flush, SIGUSR1 live dump).
+
 Lifecycle: the CLI (or any embedder) calls :func:`configure` once at
 startup and :func:`shutdown` at exit; instrumentation sites declare their
 metrics at import via :func:`counter` / :func:`gauge` / :func:`histogram`
@@ -28,6 +36,7 @@ merge into one Perfetto timeline.
 
 from __future__ import annotations
 
+import atexit
 import os
 from typing import Any, Dict, Optional
 
@@ -41,6 +50,7 @@ from tree_attention_tpu.obs.metrics import (  # noqa: F401
     counter,
     gauge,
     histogram,
+    percentile,
 )
 from tree_attention_tpu.obs.tracing import (  # noqa: F401
     SpanTracer,
@@ -49,6 +59,11 @@ from tree_attention_tpu.obs.tracing import (  # noqa: F401
     span,
     traced,
 )
+from tree_attention_tpu.obs.flight import (  # noqa: F401
+    FLIGHT,
+    FlightRecorder,
+)
+from tree_attention_tpu.obs.slo import SLOMonitor  # noqa: F401
 
 _STATE: Dict[str, Optional[str]] = {"metrics_out": None}
 
@@ -85,17 +100,22 @@ def _rank_suffixed(path: str) -> str:
 def configure(
     metrics_out: Optional[str] = None,
     trace_events: Optional[str] = None,
+    flight_out: Optional[str] = None,
 ) -> None:
     """Arm telemetry for this process.
 
     ``metrics_out``: path the exit snapshot (JSON) is written to by
     :func:`shutdown`; enables the registry. ``trace_events``: Chrome-trace
-    JSONL sink path; starts the span tracer. ``None`` falls back to
-    ``TA_METRICS_OUT`` / ``TA_TRACE_EVENTS`` so child processes inherit
-    the parent's telemetry choice.
+    JSONL sink path; starts the span tracer. ``flight_out``: arms the
+    tick flight recorder with a crash-dump sink (written by
+    :func:`shutdown`, on engine error, and by the signal handlers).
+    ``None`` falls back to ``TA_METRICS_OUT`` / ``TA_TRACE_EVENTS`` /
+    ``TA_FLIGHT_OUT`` so child processes inherit the parent's telemetry
+    choice.
     """
     metrics_out = metrics_out or os.environ.get("TA_METRICS_OUT")
     trace_events = trace_events or os.environ.get("TA_TRACE_EVENTS")
+    flight_out = flight_out or os.environ.get("TA_FLIGHT_OUT")
     if metrics_out:
         _STATE["metrics_out"] = _rank_suffixed(metrics_out)
         REGISTRY.enable()
@@ -104,19 +124,23 @@ def configure(
         # Spans without counters are half a story (and vice versa): one
         # flag arms both; --metrics-out alone still skips the JSON dump.
         REGISTRY.enable()
+    if flight_out:
+        FLIGHT.arm(_rank_suffixed(flight_out))
 
 
 def shutdown() -> Dict[str, Any]:
-    """Flush sinks: write the metrics snapshot (if configured), close the
-    tracer, and DISARM — a later run in the same process records nothing
-    (and rewrites no earlier run's file) unless it calls :func:`configure`
-    again. Metric values persist across configure cycles (process-lifetime
-    totals); only the sinks and the enabled flag reset. Idempotent.
-    Returns ``{"metrics_out": path-or-None, "trace_events": path-or-None}``
-    — the sinks THIS run actually wrote — for the caller's exit log line."""
+    """Flush sinks: write the metrics snapshot (if configured), dump the
+    flight recorder (if armed with a sink), close the tracer, and DISARM —
+    a later run in the same process records nothing (and rewrites no
+    earlier run's file) unless it calls :func:`configure` again. Metric
+    values persist across configure cycles (process-lifetime totals); only
+    the sinks and the enabled flag reset. Idempotent. Returns
+    ``{"metrics_out": ..., "trace_events": ..., "flight_out": ...}`` — the
+    sinks THIS run actually wrote — for the caller's exit log line."""
     out: Dict[str, Any] = {
         "metrics_out": None,
         "trace_events": TRACER.path if TRACER.active else None,
+        "flight_out": None,
     }
     path = _STATE["metrics_out"]
     if path and REGISTRY.enabled:
@@ -125,7 +149,75 @@ def shutdown() -> Dict[str, Any]:
             out["metrics_out"] = path
         except OSError:
             pass  # never let observability fail the run at exit
+    out["flight_out"] = FLIGHT.dump_if_armed("shutdown")
     _STATE["metrics_out"] = None
     REGISTRY.disable()
     TRACER.close()
+    FLIGHT.disarm()
     return out
+
+
+def flush() -> Dict[str, Any]:
+    """Crash-time flush: write every armed sink WITHOUT disarming — the
+    run may continue (SIGUSR1) or die an instant later (SIGTERM/atexit);
+    either way the telemetry captured so far is on disk. Safe to call
+    repeatedly; never raises."""
+    out: Dict[str, Any] = {
+        "metrics_out": None, "trace_events": None, "flight_out": None,
+    }
+    path = _STATE["metrics_out"]
+    if path and REGISTRY.enabled:
+        try:
+            REGISTRY.write_json(path)
+            out["metrics_out"] = path
+        except OSError:
+            pass
+    if TRACER.active:
+        TRACER.flush()
+        out["trace_events"] = TRACER.path
+    out["flight_out"] = FLIGHT.dump_if_armed("flush")
+    return out
+
+
+_HANDLERS: Dict[str, Any] = {"installed": False}
+
+
+def install_crash_handlers() -> bool:
+    """Make telemetry crash-safe: an interrupted run still flushes.
+
+    Registers (idempotently, main thread only — signal handlers cannot be
+    installed elsewhere; returns False in that case):
+
+    - ``atexit`` — :func:`flush` as a backstop for exits that skip the
+      caller's ``finally`` (``os._exit`` excepted; nothing catches that);
+    - ``SIGTERM`` — flush every armed sink, restore the previous handler,
+      and re-raise the signal so the process still dies with the standard
+      143 (a supervisor's kill must stay a kill);
+    - ``SIGUSR1`` — dump the flight recorder + flush and KEEP RUNNING: the
+      live "what is this server doing" poke for a wedged-looking run.
+    """
+    import signal
+
+    if _HANDLERS["installed"]:
+        return True
+    try:
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            flush()
+            signal.signal(
+                signal.SIGTERM,
+                prev_term if prev_term is not None else signal.SIG_DFL,
+            )
+            os.kill(os.getpid(), signum)
+
+        def _on_usr1(signum, frame):
+            flush()
+
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGUSR1, _on_usr1)
+    except ValueError:  # not the main thread
+        return False
+    atexit.register(flush)
+    _HANDLERS["installed"] = True
+    return True

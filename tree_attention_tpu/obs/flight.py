@@ -1,0 +1,169 @@
+"""Tick flight recorder: a bounded ring of per-tick serving records.
+
+The serving engine's aggregate metrics say *how much*; the span trace says
+*how long* — neither answers "what was the engine doing when it wedged?"
+after the process is gone. This is the black box: every tick the engine
+appends one small structured record (occupancy, slot states, chunk plan,
+tokens emitted, whether the tick paid the host sync, queue depth, wall
+time) to a fixed-capacity ring. Cost is O(1) per tick and bounded memory
+forever; the ring holds the LAST ``capacity`` ticks — exactly the window a
+post-mortem needs.
+
+Dump triggers (any of):
+
+- **on demand** — the ``/flight`` HTTP endpoint or :meth:`snapshot`;
+- **on engine error** — ``SlotServer.serve`` dumps before re-raising;
+- **on SIGTERM / SIGUSR1 / atexit** — :func:`obs.install_crash_handlers
+  <tree_attention_tpu.obs.install_crash_handlers>` flushes the armed sink
+  (``--flight-out`` / ``TA_FLIGHT_OUT``), so a killed or wedged run still
+  leaves its last ticks on disk.
+
+Liveness: :meth:`last_tick_age` is the seconds since the engine last
+recorded a tick — the ``/healthz`` endpoint's truth (a serving process
+whose ring stopped moving is wedged even if the HTTP thread still
+answers).
+
+Disabled (the default) is free: :meth:`record` is one attribute check and
+an early return; call sites must build their record dict only under an
+``if FLIGHT.enabled:`` guard — the same contract as span args.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 256
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of per-tick records; disarmed until enabled."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        # Reentrant: the SIGTERM/SIGUSR1 flush runs on the main thread and
+        # may interrupt a record() holding this lock; a plain Lock would
+        # deadlock dump_if_armed instead of dumping.
+        self._lock = threading.RLock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._ticks_recorded = 0
+        self._last_tick_t: Optional[float] = None
+        self._idle = True
+        self._dump_path: Optional[str] = None
+        self.enabled = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def arm(self, dump_path: Optional[str] = None,
+            capacity: Optional[int] = None) -> None:
+        """Enable recording; ``dump_path`` is where crash/error/signal
+        dumps land (``None`` keeps the ring memory-only — ``/flight`` and
+        :meth:`snapshot` still serve it)."""
+        with self._lock:
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=max(capacity, 1))
+            self._dump_path = dump_path
+        self.enabled = True
+
+    def disarm(self) -> None:
+        self.enabled = False
+        with self._lock:
+            self._dump_path = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._ticks_recorded = 0
+            self._last_tick_t = None
+            self._idle = True
+
+    @property
+    def dump_path(self) -> Optional[str]:
+        return self._dump_path
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    @property
+    def ticks_recorded(self) -> int:
+        return self._ticks_recorded
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, rec: Optional[Dict[str, Any]]) -> None:
+        """Append one per-tick record. The dict is the caller's — built
+        only under ``if FLIGHT.enabled:`` so the disabled path allocates
+        nothing (``record(None)`` when disabled is the no-op fast path)."""
+        if not self.enabled or rec is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._ring.append(rec)
+            self._ticks_recorded += 1
+            self._last_tick_t = now
+            self._idle = False
+
+    def mark_idle(self) -> None:
+        """Declare the tick loop drained (a serve() run completed): the
+        engine is between runs, not wedged — ``/healthz`` must not count
+        a finished run's age as a stall. The ring and liveness timestamp
+        survive for post-mortems; the next record() clears idleness."""
+        with self._lock:
+            self._idle = True
+
+    @property
+    def idle(self) -> bool:
+        return self._idle
+
+    def last_tick_age(self) -> Optional[float]:
+        """Seconds since the last recorded tick; None before any tick."""
+        t = self._last_tick_t
+        return None if t is None else max(time.monotonic() - t, 0.0)
+
+    # -- export -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            records: List[Dict[str, Any]] = list(self._ring)
+            ticks = self._ticks_recorded
+        age = self.last_tick_age()
+        return {
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "capacity": self.capacity,
+            "ticks_recorded": ticks,
+            "last_tick_age_s": None if age is None else round(age, 3),
+            "records": records,
+        }
+
+    def dump(self, path: str, reason: str = "on_demand") -> None:
+        """Write the ring as JSON (creates parent dirs)."""
+        snap = self.snapshot()
+        snap["reason"] = reason
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+            f.write("\n")
+
+    def dump_if_armed(self, reason: str) -> Optional[str]:
+        """Dump to the armed sink path, if any — the crash/error hook.
+        Never raises (the black box must not kill the workload it
+        records); returns the path written or None."""
+        path = self._dump_path
+        if not self.enabled or not path:
+            return None
+        try:
+            self.dump(path, reason=reason)
+            return path
+        except OSError:
+            return None
+
+
+#: The process-wide recorder the serving engine feeds.
+FLIGHT = FlightRecorder()

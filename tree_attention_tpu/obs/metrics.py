@@ -51,6 +51,22 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 )
 
 
+def percentile(sorted_vals: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile of an ascending sequence (``0 <= p <= 1``).
+
+    The ONE exact-percentile definition every latency report uses
+    (``ServeReport``, the SLO windows, bench records) — duplicated
+    nearest-rank variants drift in their rounding and then p95s disagree
+    across layers for no physical reason. Empty input returns 0.0 (a
+    report with no samples, not an error).
+    """
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[
+        min(len(sorted_vals) - 1, int(p * (len(sorted_vals) - 1) + 0.5))
+    ]
+
+
 def _check_name(name: str) -> None:
     if not _NAME_RE.match(name):
         raise ValueError(f"invalid metric name {name!r}")
@@ -281,6 +297,35 @@ class Histogram(_Metric):
             cum.append([le, total])
         cum.append(["+Inf", self._count])
         return {"count": self._count, "sum": self._sum, "buckets": cum}
+
+    def quantile(self, p: float) -> float:
+        """Estimate the ``p``-quantile (``0 <= p <= 1``) from the bucket
+        counts — monotone linear interpolation inside the target bucket,
+        the same model ``histogram_quantile`` applies to a Prometheus
+        scrape, so a live dashboard and this in-process value agree.
+
+        The first bucket interpolates from 0 (these are latency-shaped
+        metrics); a quantile landing in the ``+Inf`` bucket clamps to the
+        highest finite bound (there is no upper edge to interpolate
+        toward). Returns 0.0 for an empty histogram.
+        """
+        self._guard_unlabeled()
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"quantile p must be in [0, 1], got {p}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        target = p * total
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            if cum + c >= target and c > 0:
+                lo = self._buckets[i - 1] if i > 0 else 0.0
+                hi = self._buckets[i]
+                return lo + (hi - lo) * (target - cum) / c
+            cum += c
+        return self._buckets[-1]
 
 
 class MetricsRegistry:

@@ -98,7 +98,10 @@ class SpanTracer:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # Reentrant: the crash handlers (obs.flush) run on the main thread
+        # and may interrupt an _emit holding this lock — a plain Lock
+        # would deadlock the flush-then-die path instead of flushing.
+        self._lock = threading.RLock()
         self._file = None
         self._path: Optional[str] = None
         self._pid = 0

@@ -64,6 +64,9 @@ from jax import lax
 from jax.sharding import Mesh
 
 from tree_attention_tpu import obs
+from tree_attention_tpu.obs.flight import FLIGHT
+from tree_attention_tpu.obs.metrics import percentile
+from tree_attention_tpu.obs.slo import SLOMonitor
 from tree_attention_tpu.models.decode import (
     KVCache,
     QuantKVCache,
@@ -144,14 +147,6 @@ class RequestResult:
     ttft_s: float = 0.0  # visible -> first sampled token, wall seconds
 
 
-def _pct(sorted_vals: List[float], p: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    return sorted_vals[
-        min(len(sorted_vals) - 1, int(p * (len(sorted_vals) - 1) + 0.5))
-    ]
-
-
 @dataclasses.dataclass
 class ServeReport:
     """One serve() run: per-request results plus aggregate accounting."""
@@ -162,6 +157,7 @@ class ServeReport:
     tokens_generated: int
     mean_occupancy: float  # live slots per executed decode tick
     tbt_s: List[float] = dataclasses.field(default_factory=list)
+    slo: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -169,7 +165,7 @@ class ServeReport:
 
     def completion_percentiles(self) -> Dict[str, float]:
         cs = sorted(r.completion_s for r in self.results)
-        return {"p50_s": _pct(cs, 0.50), "p95_s": _pct(cs, 0.95)}
+        return {"p50_s": percentile(cs, 0.50), "p95_s": percentile(cs, 0.95)}
 
     def latency_percentiles(self) -> Dict[str, float]:
         """TTFT (visible -> first token) and inter-token latency (gap
@@ -178,10 +174,10 @@ class ServeReport:
         ttft = sorted(r.ttft_s for r in self.results)
         tbt = sorted(self.tbt_s)
         return {
-            "ttft_p50_s": _pct(ttft, 0.50),
-            "ttft_p95_s": _pct(ttft, 0.95),
-            "tbt_p50_s": _pct(tbt, 0.50),
-            "tbt_p95_s": _pct(tbt, 0.95),
+            "ttft_p50_s": percentile(ttft, 0.50),
+            "ttft_p95_s": percentile(ttft, 0.95),
+            "tbt_p50_s": percentile(tbt, 0.50),
+            "tbt_p95_s": percentile(tbt, 0.95),
         }
 
     def as_dict(self) -> Dict[str, Any]:
@@ -196,6 +192,7 @@ class ServeReport:
             "queue_wait_p50_s": round(waits[len(waits) // 2], 4) if waits else 0.0,
             **{k: round(v, 4) for k, v in self.completion_percentiles().items()},
             **{k: round(v, 5) for k, v in self.latency_percentiles().items()},
+            **({"slo": self.slo} if self.slo else {}),
         }
 
 
@@ -268,6 +265,11 @@ class SlotServer:
         budget only bounds KV-write traffic per tick.
       admission: ``"chunked"`` (default — stall-free, fused into the tick)
         or ``"whole"`` (legacy blocking whole-prompt prefill + insert).
+      slo_ttft / slo_tbt / slo_window: the sliding-window SLO monitor's
+        targets (seconds) and sample window — a retired request counts
+        toward goodput iff its TTFT and worst inter-token gap both met
+        the target. The monitor always feeds ``ServeReport.slo``; its
+        gauges only publish while the metrics registry records.
     """
 
     def __init__(
@@ -285,6 +287,9 @@ class SlotServer:
         prefill_chunk: int = 256,
         prefill_budget: Optional[int] = None,
         admission: str = "chunked",
+        slo_ttft: float = 1.0,
+        slo_tbt: float = 0.2,
+        slo_window: int = 1024,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -355,6 +360,19 @@ class SlotServer:
         self._prefill_fifo: List[int] = []  # prefilling slots, admit order
         self._last_tok_t: List[float] = [0.0] * slots
         self._tok_host = np.zeros((slots,), np.int32)
+
+        # Observability plane (PR 4): a per-request span held open from
+        # admit to retire (None while the slot is free / tracing is off),
+        # the slot's worst inter-token gap (the SLO verdict's TBT side),
+        # and its chunk ordinal (the "chunk k/N" trace tag). The SLO
+        # monitor itself always runs — it feeds ServeReport.slo — but its
+        # gauges only publish while the registry records.
+        self._slot_span: List[Optional[Any]] = [None] * slots
+        self._slot_max_tbt: List[float] = [0.0] * slots
+        self._chunk_k: List[int] = [0] * slots
+        self.slo = SLOMonitor(
+            ttft_slo=slo_ttft, tbt_slo=slo_tbt, window=slo_window
+        )
 
         # Quantized + chunked admission stages the exact prefill in ONE
         # preallocated B=1 cache (int8 slots cannot hold exact chunk
@@ -580,6 +598,24 @@ class SlotServer:
         self._slot_req[slot] = req
         self._slot_tokens[slot] = []
         self._slot_admit[slot] = (tick, visible_at)
+        self._slot_max_tbt[slot] = 0.0
+        self._chunk_k[slot] = 0
+        self.slo.observe_queue_wait(waited)
+        # The request's life as ONE span (admit -> retire; rid in args so
+        # a Perfetto query groups every event of one request), plus an
+        # admitted instant on the timeline.
+        self._slot_span[slot] = obs.span(
+            f"request:{req.uid}", cat="serving",
+            args=None if not obs.TRACER.active else {
+                "rid": req.uid, "slot": slot, "admit_tick": tick,
+                "prompt_len": len(req.prompt),
+            },
+        )
+        if obs.TRACER.active:
+            obs.instant("request_admitted", cat="serving", args={
+                "rid": req.uid, "slot": slot, "tick": tick,
+                "queue_wait_s": round(waited, 6),
+            })
         if self.admission == "chunked":
             self._prompt_np[slot] = np.asarray(req.prompt, np.int32)
             self._prefill_pos[slot] = 0
@@ -638,11 +674,22 @@ class SlotServer:
         pos = self._prefill_pos[slot]
         rows = self._prompt_np[slot][pos:pos + n]
         self._prefill_pos[slot] = pos + n
+        self._chunk_k[slot] += 1
         if last:
             self._slot_state[slot] = "await"
             self._prefill_fifo.remove(slot)
         if obs.REGISTRY.enabled:
             _PREFILL_CHUNKS.inc()
+        if obs.TRACER.active:
+            plen = len(self._slot_req[slot].prompt)
+            obs.instant("prefill_chunk", cat="serving", args={
+                "rid": self._slot_req[slot].uid, "slot": slot,
+                # Nominal k/N (a tick's budget can shrink a chunk, so k
+                # may run past N; the pos/plen pair is the exact truth).
+                "chunk": f"{self._chunk_k[slot]}/"
+                         f"{-(-plen // self.prefill_chunk)}",
+                "n": int(n), "pos": pos + n, "prompt_len": plen,
+            })
         return rows, pos == 0
 
     def _run_staged_chunk(self, slot: int, n: int, last: bool) -> None:
@@ -683,6 +730,22 @@ class SlotServer:
             outcome=outcome,
             ttft_s=self._slot_ttft[slot],
         ))
+        self.slo.observe_request(
+            self._slot_ttft[slot], self._slot_max_tbt[slot]
+        )
+        span = self._slot_span[slot]
+        if span is not None:
+            if obs.TRACER.active:
+                span.set(
+                    outcome=outcome, tokens=len(self._slot_tokens[slot]),
+                    ttft_s=round(self._slot_ttft[slot], 6),
+                )
+                obs.instant("request_retired", cat="serving", args={
+                    "rid": req.uid, "slot": slot, "tick": tick,
+                    "outcome": outcome,
+                })
+            span.__exit__(None, None, None)
+            self._slot_span[slot] = None
         self._slot_req[slot] = None
         self._slot_tokens[slot] = []
         self._slot_state[slot] = "free"
@@ -709,146 +772,244 @@ class SlotServer:
         tokens = 0
         t0 = time.monotonic()
 
-        while pending or any(st != "free" for st in self._slot_state):
-            if max_ticks is not None and tick >= max_ticks:
-                raise RuntimeError(
-                    f"serve() exceeded max_ticks={max_ticks} with "
-                    f"{len(pending)} pending request(s)"
+        try:
+            while pending or any(st != "free" for st in self._slot_state):
+                if max_ticks is not None and tick >= max_ticks:
+                    raise RuntimeError(
+                        f"serve() exceeded max_ticks={max_ticks} with "
+                        f"{len(pending)} pending request(s)"
+                    )
+                now = time.monotonic()
+                visible = 0
+                for r in pending:  # sorted by arrival_tick — stop at future
+                    if r.arrival_tick > tick:
+                        break
+                    visible += 1
+                    if r.uid not in visible_wall:
+                        visible_wall[r.uid] = now
+                        if obs.TRACER.active:
+                            obs.instant("request_queued", cat="serving",
+                                        args={"rid": r.uid, "tick": tick})
+
+                # Admit: oldest visible request per free slot. Chunked
+                # admission is pure bookkeeping (the chunks run inside the
+                # tick); the staged (quantized) variant holds one prompt in
+                # flight at a time, so admission waits for the stage.
+                free = self._free_slots()
+                while free and pending and pending[0].arrival_tick <= tick:
+                    if self._staged_prefill and self._prefill_fifo:
+                        break
+                    req = pending.popleft()
+                    slot = free.pop(0)
+                    visible -= 1
+                    vis = visible_wall.setdefault(req.uid, now)
+                    wait_ledger[req.uid] = self._admit(req, slot, tick, vis)
+                queue_depth = visible  # visible but still unadmitted
+
+                # Plan this tick's prefill chunks (chunked admission only).
+                plan = (self._plan_chunks()
+                        if self.admission == "chunked" else [])
+                chunk_tokens = sum(n for _, n, _ in plan)
+                # The staged path rebinds ``plan`` to []; keep the tick's
+                # real chunk plan reachable for the flight record (a
+                # reference, not a copy — free when the recorder is off).
+                plan_rec = plan
+                live_idx = [i for i, st in enumerate(self._slot_state)
+                            if st == "live"]
+                if obs.REGISTRY.enabled:
+                    _SLOTS_OCCUPIED.set(len(live_idx))
+
+                # The per-tick mixed-step span: occupancy, chunk-budget
+                # spent, and queue depth tagged on the one program the
+                # tick dispatches (host_sync set before close).
+                tick_span = obs.span(
+                    "serving:tick", cat="serving",
+                    args=None if not obs.TRACER.active else {
+                        "tick": tick, "occupancy": len(live_idx),
+                        "prefilling": len(self._prefill_fifo),
+                        "chunk_tokens": chunk_tokens,
+                        "queue_depth": queue_depth,
+                    },
                 )
-            now = time.monotonic()
-            for r in pending:  # sorted by arrival_tick — stop at the future
-                if r.arrival_tick > tick:
-                    break
-                visible_wall.setdefault(r.uid, now)
+                with tick_span:
+                    ran_staged = False
+                    if self._staged_prefill and plan:
+                        for slot, n, last in plan:
+                            self._run_staged_chunk(slot, n, last)
+                        plan = []
+                        ran_staged = True
 
-            # Admit: oldest visible request per free slot. Chunked
-            # admission is pure bookkeeping (the chunks run inside the
-            # tick); the staged (quantized) variant holds one prompt in
-            # flight at a time, so admission waits for the stage.
-            free = self._free_slots()
-            while free and pending and pending[0].arrival_tick <= tick:
-                if self._staged_prefill and self._prefill_fifo:
-                    break
-                req = pending.popleft()
-                slot = free.pop(0)
-                vis = visible_wall.setdefault(req.uid, now)
-                wait_ledger[req.uid] = self._admit(req, slot, tick, vis)
+                    stepped = False
+                    if plan:
+                        # The fused mixed tick: decode rows + prefill
+                        # chunks in ONE compiled program; chunks write
+                        # straight into each slot's region of the batch
+                        # cache at its running offset.
+                        tq = self._chunk_bucket(max(n for _, n, _ in plan))
+                        mat = np.zeros((self.slots, tq), np.int32)
+                        n_vec = np.zeros((self.slots,), np.int32)
+                        reset = np.zeros((self.slots,), bool)
+                        emit = np.zeros((self.slots,), bool)
+                        for i in live_idx:
+                            mat[i, 0] = self._tok_host[i]
+                            n_vec[i] = 1
+                            emit[i] = True
+                        for slot, n, last in plan:
+                            rows, first = self._consume_chunk(slot, n, last)
+                            mat[slot, :n] = rows
+                            n_vec[slot] = n
+                            reset[slot] = first
+                            emit[slot] = last
+                        self.tok, self.cache, self._key = self._mixed(
+                            self.params, jnp.asarray(mat),
+                            jnp.asarray(n_vec), jnp.asarray(reset),
+                            jnp.asarray(emit), self.cache, self._key,
+                        )
+                        stepped = True
+                    elif live_idx:
+                        # Pure-decode tick: the SAME program at the Tq=1
+                        # bucket, tokens carried on device (awaiting slots
+                        # hold their parked first token through n=0 /
+                        # emit=False).
+                        n_vec = np.zeros((self.slots,), np.int32)
+                        emit = np.zeros((self.slots,), bool)
+                        n_vec[live_idx] = 1
+                        emit[live_idx] = True
+                        self.tok, self.cache, self._key = self._mixed(
+                            self.params, self.tok[:, None],
+                            jnp.asarray(n_vec),
+                            jnp.zeros((self.slots,), bool),
+                            jnp.asarray(emit), self.cache, self._key,
+                        )
+                        stepped = True
 
-            # Plan this tick's prefill chunks (chunked admission only).
-            plan = self._plan_chunks() if self.admission == "chunked" else []
-            ran_staged = False
-            if self._staged_prefill and plan:
-                for slot, n, last in plan:
-                    self._run_staged_chunk(slot, n, last)
-                plan = []
-                ran_staged = True
+                    awaits = [i for i, st in enumerate(self._slot_state)
+                              if st == "await"]
+                    host_sync = bool(awaits or live_idx)
+                    tokens_this_tick = 0
+                    if host_sync:
+                        # THE per-tick host sync: every new token of this
+                        # tick — decode samples, fused final-chunk first
+                        # tokens, legacy insert first tokens — in one
+                        # batched fetch. Only ticks that produced a token
+                        # pay it: a fused tick of nothing but mid-prompt
+                        # chunks skips the fetch (like the staged path
+                        # below), letting consecutive chunks pipeline in
+                        # the dispatch queue. A live slot always enters
+                        # its tick with a fresh ``_tok_host`` — it went
+                        # live inside this block.
+                        self._tok_host = np.asarray(self.tok)
+                        now2 = time.monotonic()
+                        if live_idx:
+                            decode_ticks += 1
+                            occupancy += len(live_idx)
+                        for i in awaits:
+                            req = self._slot_req[i]
+                            first = int(self._tok_host[i])
+                            self._slot_tokens[i] = [first]
+                            self._slot_state[i] = "live"
+                            _, vis = self._slot_admit[i]
+                            self._slot_ttft[i] = max(now2 - vis, 0.0)
+                            self._last_tok_t[i] = now2
+                            tokens_this_tick += 1
+                            self.slo.observe_ttft(self._slot_ttft[i])
+                            if obs.REGISTRY.enabled:
+                                _TOKENS.inc()  # the prefill's first token
+                                _TTFT.observe(self._slot_ttft[i])
+                            if obs.TRACER.active:
+                                obs.instant(
+                                    "first_token", cat="serving", args={
+                                        "rid": req.uid, "slot": i,
+                                        "tick": tick,
+                                        "ttft_s": round(
+                                            self._slot_ttft[i], 6),
+                                    })
+                            if req.eos_id is not None \
+                                    and first == req.eos_id:
+                                self._retire(i, tick, "eos", results)
+                            elif req.max_new_tokens <= 1:
+                                self._retire(i, tick, "max_tokens",
+                                             results)
+                        for i in live_idx:
+                            req = self._slot_req[i]
+                            tok_i = int(self._tok_host[i])
+                            self._slot_tokens[i].append(tok_i)
+                            tokens += 1
+                            tokens_this_tick += 1
+                            gap = max(now2 - self._last_tok_t[i], 0.0)
+                            tbt.append(gap)
+                            self._last_tok_t[i] = now2
+                            if gap > self._slot_max_tbt[i]:
+                                self._slot_max_tbt[i] = gap
+                            self.slo.observe_tbt(gap)
+                            if obs.REGISTRY.enabled:
+                                _TOKENS.inc()
+                                _TBT.observe(gap)
+                            if req.eos_id is not None \
+                                    and tok_i == req.eos_id:
+                                self._retire(i, tick, "eos", results)
+                            elif (len(self._slot_tokens[i])
+                                    >= req.max_new_tokens):
+                                self._retire(i, tick, "max_tokens",
+                                             results)
+                    if obs.TRACER.active:
+                        tick_span.set(host_sync=host_sync,
+                                      tokens=tokens_this_tick)
 
-            live_idx = [i for i, st in enumerate(self._slot_state)
-                        if st == "live"]
-            if obs.REGISTRY.enabled:
-                _SLOTS_OCCUPIED.set(len(live_idx))
+                # The flight recorder's per-tick record (the black box a
+                # post-mortem replays); record dict built only when armed.
+                if FLIGHT.enabled:
+                    FLIGHT.record({
+                        "tick": tick,
+                        "t_s": round(now - t0, 6),
+                        "occupancy": len(live_idx),
+                        "states": list(self._slot_state),
+                        "lengths": [self._prefill_pos[i]
+                                    if self._slot_state[i] == "prefill"
+                                    else len(self._slot_tokens[i])
+                                    for i in range(self.slots)],
+                        "chunk_plan": [[s, int(n), bool(last)]
+                                       for s, n, last in plan_rec],
+                        "chunk_tokens": chunk_tokens,
+                        "tokens_emitted": tokens_this_tick,
+                        "host_sync": host_sync,
+                        "queue_depth": queue_depth,
+                        "pending": len(pending),
+                    })
+                self.slo.maybe_export(now)
 
-            stepped = False
-            if plan:
-                # The fused mixed tick: decode rows + prefill chunks in
-                # ONE compiled program; chunks write straight into each
-                # slot's region of the batch cache at its running offset.
-                tq = self._chunk_bucket(max(n for _, n, _ in plan))
-                mat = np.zeros((self.slots, tq), np.int32)
-                n_vec = np.zeros((self.slots,), np.int32)
-                reset = np.zeros((self.slots,), bool)
-                emit = np.zeros((self.slots,), bool)
-                for i in live_idx:
-                    mat[i, 0] = self._tok_host[i]
-                    n_vec[i] = 1
-                    emit[i] = True
-                for slot, n, last in plan:
-                    rows, first = self._consume_chunk(slot, n, last)
-                    mat[slot, :n] = rows
-                    n_vec[slot] = n
-                    reset[slot] = first
-                    emit[slot] = last
-                self.tok, self.cache, self._key = self._mixed(
-                    self.params, jnp.asarray(mat), jnp.asarray(n_vec),
-                    jnp.asarray(reset), jnp.asarray(emit), self.cache,
-                    self._key,
-                )
-                stepped = True
-            elif live_idx:
-                # Pure-decode tick: the SAME program at the Tq=1 bucket,
-                # tokens carried on device (awaiting slots hold their
-                # parked first token through n=0 / emit=False).
-                n_vec = np.zeros((self.slots,), np.int32)
-                emit = np.zeros((self.slots,), bool)
-                n_vec[live_idx] = 1
-                emit[live_idx] = True
-                self.tok, self.cache, self._key = self._mixed(
-                    self.params, self.tok[:, None], jnp.asarray(n_vec),
-                    jnp.zeros((self.slots,), bool), jnp.asarray(emit),
-                    self.cache, self._key,
-                )
-                stepped = True
+                if host_sync or stepped or ran_staged:
+                    tick += 1
+                elif pending:
+                    # Nothing running: fast-forward trace time to the next
+                    # arrival instead of spinning empty decode steps.
+                    tick = max(tick + 1,
+                               min(r.arrival_tick for r in pending))
+                else:
+                    break  # admit phase drained all without device work
+        except BaseException as e:
+            # The black-box contract: a wedged/crashed tick loop leaves
+            # its last ticks on disk before the exception propagates.
+            FLIGHT.dump_if_armed(f"engine_error:{type(e).__name__}")
+            if obs.TRACER.active:
+                obs.instant("engine_error", cat="serving", args={
+                    "error": type(e).__name__, "tick": tick,
+                })
+            raise
 
-            awaits = [i for i, st in enumerate(self._slot_state)
-                      if st == "await"]
-            if awaits or live_idx:
-                # THE per-tick host sync: every new token of this tick —
-                # decode samples, fused final-chunk first tokens, legacy
-                # insert first tokens — in one batched fetch. Only ticks
-                # that produced a token pay it: a fused tick of nothing
-                # but mid-prompt chunks skips the fetch (like the staged
-                # path below), letting consecutive chunks pipeline in the
-                # dispatch queue. A live slot always enters its tick with
-                # a fresh ``_tok_host`` — it went live inside this block.
-                self._tok_host = np.asarray(self.tok)
-                now2 = time.monotonic()
-                if live_idx:
-                    decode_ticks += 1
-                    occupancy += len(live_idx)
-                for i in awaits:
-                    req = self._slot_req[i]
-                    first = int(self._tok_host[i])
-                    self._slot_tokens[i] = [first]
-                    self._slot_state[i] = "live"
-                    _, vis = self._slot_admit[i]
-                    self._slot_ttft[i] = max(now2 - vis, 0.0)
-                    self._last_tok_t[i] = now2
-                    if obs.REGISTRY.enabled:
-                        _TOKENS.inc()  # the prefill's first sampled token
-                        _TTFT.observe(self._slot_ttft[i])
-                    if req.eos_id is not None and first == req.eos_id:
-                        self._retire(i, tick, "eos", results)
-                    elif req.max_new_tokens <= 1:
-                        self._retire(i, tick, "max_tokens", results)
-                for i in live_idx:
-                    req = self._slot_req[i]
-                    tok_i = int(self._tok_host[i])
-                    self._slot_tokens[i].append(tok_i)
-                    tokens += 1
-                    tbt.append(max(now2 - self._last_tok_t[i], 0.0))
-                    self._last_tok_t[i] = now2
-                    if obs.REGISTRY.enabled:
-                        _TOKENS.inc()
-                        _TBT.observe(tbt[-1])
-                    if req.eos_id is not None and tok_i == req.eos_id:
-                        self._retire(i, tick, "eos", results)
-                    elif len(self._slot_tokens[i]) >= req.max_new_tokens:
-                        self._retire(i, tick, "max_tokens", results)
-                tick += 1
-            elif stepped or ran_staged:
-                tick += 1  # mid-prompt chunk tick: progress, no fetch
-            elif pending:
-                # Nothing running: fast-forward trace time to the next
-                # arrival instead of spinning empty decode steps.
-                tick = max(tick + 1, min(r.arrival_tick for r in pending))
-            else:
-                break  # admit phase drained everything without device work
-
+        if FLIGHT.enabled:
+            # Drained, not wedged: /healthz stays 200 "idle" between runs
+            # however long this run's last tick ages.
+            FLIGHT.mark_idle()
         wall = time.monotonic() - t0
         for res in results:
             res.queue_wait_s = wait_ledger.get(res.uid, 0.0)
         # Prefill-sampled first tokens count toward the total.
         tokens += sum(1 for _ in results)
+        # Final SLO publication: the gauges reflect the run's end state and
+        # the report carries the windowed snapshot (goodput + percentiles).
+        self.slo.export_gauges()
+        slo_snap = self.slo.snapshot()
         log.info(
             "served %d request(s): %d tokens over %d decode tick(s), "
             "%.1f tok/s, mean occupancy %.2f/%d",
@@ -863,4 +1024,5 @@ class SlotServer:
             tokens_generated=tokens,
             mean_occupancy=occupancy / max(decode_ticks, 1),
             tbt_s=tbt,
+            slo=slo_snap,
         )

@@ -86,6 +86,8 @@ class RunConfig:
     prefill_chunk: int = 256  # max prompt tokens one tick writes per slot
     prefill_budget: Optional[int] = None  # per-tick prompt-token budget
     admission: str = "chunked"  # "chunked" (stall-free) | "whole" (legacy)
+    slo_ttft: float = 1.0    # TTFT target (s) for the goodput SLO
+    slo_tbt: float = 0.2     # worst inter-token-gap target (s), ditto
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -104,6 +106,8 @@ class RunConfig:
     profile_dir: Optional[str] = None
     metrics_out: Optional[str] = None   # JSON metrics snapshot at exit
     trace_events: Optional[str] = None  # Chrome-trace JSONL span sink
+    metrics_port: Optional[int] = None  # live /metrics HTTP exporter
+    flight_out: Optional[str] = None    # tick flight-recorder dump sink
 
     def mesh_axes(self) -> Optional[Dict[str, int]]:
         return parse_mesh_spec(self.mesh) if self.mesh else None
@@ -244,6 +248,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="serve mode: 'chunked' fuses prefill chunks into "
                         "the per-tick mixed step (stall-free); 'whole' is "
                         "the legacy blocking whole-prompt prefill + insert")
+    p.add_argument("--slo-ttft", type=float, default=d.slo_ttft,
+                   metavar="SEC",
+                   help="serve mode: TTFT target of the goodput SLO — a "
+                        "retired request counts as good iff its first "
+                        "token arrived within SEC and no inter-token gap "
+                        "exceeded --slo-tbt")
+    p.add_argument("--slo-tbt", type=float, default=d.slo_tbt,
+                   metavar="SEC",
+                   help="serve mode: worst-inter-token-gap target of the "
+                        "goodput SLO (see --slo-ttft)")
     p.add_argument("--host-data", action="store_true", default=d.host_data,
                    help="train mode: feed batches from the native prefetching "
                         "host pipeline instead of on-device RNG")
@@ -277,6 +291,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="emit host-side spans as Chrome-trace-format JSONL "
                         "(one JSON event per line; load in Perfetto "
                         "alongside a --profile-dir device trace)")
+    p.add_argument("--metrics-port", type=int, default=d.metrics_port,
+                   metavar="PORT",
+                   help="serve the live telemetry HTTP endpoint on "
+                        "localhost:PORT — /metrics (Prometheus text), "
+                        "/metrics.json (registry snapshot), /healthz "
+                        "(tick liveness), /flight (flight-recorder ring); "
+                        "0 picks a free port (logged). Arms the registry "
+                        "and flight recorder even without --metrics-out")
+    p.add_argument("--flight-out", default=d.flight_out, metavar="PATH",
+                   help="arm the serving tick flight recorder and dump "
+                        "its ring (last ticks: occupancy, slot states, "
+                        "chunk plan, queue depth) to PATH at exit, on "
+                        "engine error, and on SIGTERM/SIGUSR1")
     return p
 
 
