@@ -623,6 +623,18 @@ def _serving_flood_record():
     return bench_serving_flood()
 
 
+def _serving_prefix_record():
+    """Shared-prefix flood (ISSUE 5): TTFT p50/p95 with the radix prefix
+    KV cache on vs off over a trace where >= 50% of requests share a
+    512-token prompt prefix (RadixAttention, arXiv:2312.07104), plus the
+    chain_slope-priced ratio of one shared-prefix prefill vs the donated
+    pool gather that replaces it on a hit. CPU proxy; the avoided-prefill
+    structure transfers. See tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_prefix_flood
+
+    return bench_serving_prefix_flood()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -854,6 +866,7 @@ def _run_suite() -> None:
     run("tree_vs_ring_decode_cpu8", _tree_vs_ring_decode_record)
     run("serving_continuous_batching", _serving_record)
     run("serving_chunked_prefill_flood", _serving_flood_record)
+    run("serving_prefix_flood", _serving_prefix_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -954,6 +967,17 @@ def _summarize_record(name, rec):
             g = trace.get(mode, {}).get("goodput")
             if g is not None:
                 out[f"goodput_{mode}"] = g
+    if name == "serving_prefix_flood":
+        slope = rec.get("slope", {})
+        if "prefill_avoided_ratio" in slope:
+            out["prefill_avoided_ratio"] = slope["prefill_avoided_ratio"]
+        trace = rec.get("trace", {})
+        for key in ("ttft_p50_improvement", "ttft_p95_improvement"):
+            if key in trace:
+                out[key] = trace[key]
+        reused = trace.get("on", {}).get("tokens_reused_ratio")
+        if reused is not None:
+            out["tokens_reused_ratio"] = reused
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

@@ -1,0 +1,84 @@
+"""tools/bench_compare.py: metric-family classification + regression calls.
+
+The guard's whole value is classifying leaf keys correctly — a key routed
+to the wrong family either cries wolf on noise or waves a regression
+through. Pinned here: the prefix-reuse family additions (ISSUE 5), the
+graceful skip of unknown/config keys, and the three regression verdicts.
+Pure host logic, no JAX.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                 "bench_compare.py"),
+)
+bc = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bc)
+
+
+@pytest.mark.parametrize("key,family", [
+    # Prefix-reuse ratios are larger-is-better measurements...
+    ("tokens_reused_ratio", bc.LARGER_IS_BETTER),
+    ("prefill_avoided_ratio", bc.LARGER_IS_BETTER),
+    ("ttft_p50_improvement", bc.LARGER_IS_BETTER),
+    # ...while pool-state counts and workload echoes are not performance
+    # and must be skipped (they vary with trace interleaving).
+    ("hits", None),
+    ("misses", None),
+    ("evictions", None),
+    ("tokens_reused", None),
+    ("pool_blocks_used", None),
+    ("prefix_len", None),
+    ("prefix_block", None),
+    ("prefix_share", None),
+    # Unknown keys skip gracefully rather than crash or guess.
+    ("some_future_metric", None),
+    ("notes", None),
+    # The pre-existing families still route correctly.
+    ("ttft_p50_s", bc.SMALLER_IS_BETTER),
+    ("us_per_prefix_gather", bc.SMALLER_IS_BETTER),
+    ("tokens_per_sec", bc.LARGER_IS_BETTER),
+    ("collective_dispatch_total", bc.EXACT),
+])
+def test_classify_families(key, family):
+    assert bc.classify(key) == family
+
+
+def _rec(**trace):
+    return {"serving_prefix_flood": {"trace": trace}}
+
+
+def test_compare_flags_ratio_regressions_and_skips_counts():
+    base = _rec(ttft_p50_improvement=20.0, on={
+        "tokens_reused_ratio": 0.7, "hits": 6, "evictions": 0,
+    })
+    # Counts changing is NOT a regression; ratios collapsing IS.
+    cand = _rec(ttft_p50_improvement=2.0, on={
+        "tokens_reused_ratio": 0.1, "hits": 1, "evictions": 40,
+    })
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 2
+    assert any("ttft_p50_improvement" in r for r in regs)
+    assert any("tokens_reused_ratio" in r for r in regs)
+
+
+def test_compare_within_tolerance_is_clean():
+    base = _rec(ttft_p50_improvement=20.0, on={"tokens_reused_ratio": 0.7})
+    cand = _rec(ttft_p50_improvement=17.0, on={"tokens_reused_ratio": 0.68})
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert regs == []
+
+
+def test_compare_new_record_is_note_not_regression():
+    regs, notes = bc.compare({}, _rec(ttft_p50_improvement=20.0),
+                             rtol_time=0.3, rtol_throughput=0.2,
+                             rtol_exact=0.0)
+    assert regs == []
+    assert any("new in candidate" in n for n in notes)

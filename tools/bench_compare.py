@@ -55,7 +55,8 @@ EXACT = "exact"
 
 _LARGER_SUBSTRINGS = (
     "tokens_per_sec", "flops_per_sec", "speedup", "improvement",
-    "goodput", "roofline_frac", "stall_ratio",
+    "goodput", "roofline_frac", "stall_ratio", "avoided_ratio",
+    "reused_ratio", "hit_rate",
 )
 _EXACT_SUFFIXES = ("_total", "_bytes", "_count")
 _SMALLER_SUFFIXES = ("_us", "_s", "_seconds", "_ms")
@@ -67,6 +68,10 @@ _IGNORE_KEYS = frozenset((
     "prompt_len", "prompt_jitter", "max_new_tokens", "arrival_every",
     "prefill_chunk", "prompt_bucket", "cache_len", "window",
     "spread_pct", "ratio_spread_pct", "slope_spread_pct",
+    # Prefix-cache workload echoes and pool-state counts (hits/misses/
+    # evictions vary with trace interleaving, not performance).
+    "prefix_len", "prefix_block", "prefix_share", "pool_blocks",
+    "pool_blocks_used", "hits", "misses", "evictions", "tokens_reused",
 ))
 
 

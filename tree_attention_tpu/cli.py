@@ -480,6 +480,13 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
             f"--impl {cfg.impl} cannot serve a quantized buffer"
         )
+    if not 0.0 <= cfg.prefix_share <= 1.0:
+        raise SystemExit("--prefix-share must be in [0, 1]")
+    if cfg.prefix_cache and (cfg.prefix_block < 1
+                             or cfg.prefix_block & (cfg.prefix_block - 1)):
+        raise SystemExit("--prefix-block must be a power of two >= 1")
+    if cfg.prefix_cache and cfg.prefix_pool_blocks < 1:
+        raise SystemExit("--prefix-pool-blocks must be >= 1")
     # The cache is sized from the trace itself: longest possible prompt
     # plus the per-request budget, through the same rounding rule
     # generate() uses.
@@ -488,6 +495,14 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     cache_len = round_cache_len(
         cfg.prompt_len + cfg.prompt_jitter + cfg.max_new_tokens, mesh
     )
+    if cfg.prefix_cache and cfg.prefix_block > cache_len:
+        # Same clean rejection every sibling flag misuse gets — the
+        # engine would raise the equivalent ValueError as a traceback.
+        raise SystemExit(
+            f"--prefix-block {cfg.prefix_block} exceeds the trace's slot "
+            f"capacity {cache_len} (prompt-len + jitter + max-new-tokens, "
+            f"rounded)"
+        )
     import dataclasses as _dc
 
     tcfg = _transformer_config(_dc.replace(cfg, seq_len=cache_len))
@@ -500,6 +515,8 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         arrival_every=cfg.arrival_every,
         vocab_size=tcfg.vocab_size,
         seed=cfg.seed + 1,
+        prefix_share=cfg.prefix_share,
+        prefix_len=cfg.prefix_len,
     )
     if cfg.slo_ttft <= 0 or cfg.slo_tbt <= 0:
         raise SystemExit("--slo-ttft and --slo-tbt must be > 0")
@@ -514,6 +531,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         admission=cfg.admission,
         slo_ttft=cfg.slo_ttft,
         slo_tbt=cfg.slo_tbt,
+        prefix_cache=cfg.prefix_cache,
+        prefix_block=cfg.prefix_block,
+        prefix_pool_blocks=cfg.prefix_pool_blocks,
     )
     from tree_attention_tpu.host_runtime import heartbeat
 
@@ -532,6 +552,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         "cache_len": cache_len,
         "admission": cfg.admission,
         "prefill_chunk": cfg.prefill_chunk,
+        **({"prefix_cache": {
+            "block": cfg.prefix_block,
+            "pool_blocks": cfg.prefix_pool_blocks,
+        }} if cfg.prefix_cache else {}),
         **report.as_dict(),
         "outcomes": {
             o: sum(1 for r in report.results if r.outcome == o)

@@ -413,6 +413,96 @@ def forward_step(
     return logits, new_cache
 
 
+def insert_prefix_blocks(
+    cache: KVCache,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    ids: jax.Array,
+    matched: jax.Array,
+    slot: jax.Array,
+) -> KVCache:
+    """Copy ``matched`` tokens of pooled prefix KV into one cache slot.
+
+    The prefix-cache hit path (:mod:`tree_attention_tpu.serving
+    .prefix_cache`): ``pool_k``/``pool_v`` are ``(P, L, Hkv, block, D)``
+    block pools, ``ids`` the ``(nb,)`` pool rows holding the matched
+    prefix in prompt order (padded entries may repeat a valid id — rows at
+    token positions ``>= matched`` are masked off), and the copy lands at
+    token positions ``[0, matched)`` of slot ``slot``, setting that slot's
+    ``length`` to ``matched``. One gather + one read-modify-write window —
+    bytes at ``>= nb * block`` are untouched, bytes in ``[matched,
+    nb * block)`` keep their previous values, so the slot is exactly "a
+    prefill of the matched prefix happened here". ``nb * block`` must not
+    exceed the cache capacity (callers bucket ``nb`` under that cap).
+    """
+    nb = ids.shape[0]
+    block = pool_k.shape[3]
+    span = nb * block
+    matched = jnp.asarray(matched, jnp.int32)
+
+    def place(buf: jax.Array, pool: jax.Array) -> jax.Array:
+        rows = jnp.moveaxis(pool[ids], 0, 2)  # (L, Hkv, nb, block, D)
+        L, Hkv = rows.shape[0], rows.shape[1]
+        rows = rows.reshape(L, Hkv, span, rows.shape[-1])
+        cur = lax.dynamic_index_in_dim(buf, slot, axis=1, keepdims=False)
+        window = lax.dynamic_slice_in_dim(cur, 0, span, axis=2)
+        valid = (
+            jnp.arange(span, dtype=jnp.int32) < matched
+        )[None, None, :, None]
+        merged = jnp.where(valid, rows.astype(buf.dtype), window)
+        cur = lax.dynamic_update_slice_in_dim(cur, merged, 0, axis=2)
+        return lax.dynamic_update_index_in_dim(buf, cur, slot, axis=1)
+
+    length = lax.dynamic_update_index_in_dim(
+        cache.length, matched, slot, axis=0
+    )
+    return KVCache(
+        k=place(cache.k, pool_k), v=place(cache.v, pool_v), length=length
+    )
+
+
+def extract_prefix_blocks(
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    slot: jax.Array,
+    ids: jax.Array,
+    start_block: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Publish one slot's prefix KV rows into pool blocks (the scatter).
+
+    Inverse of :func:`insert_prefix_blocks`: token rows ``[start_block *
+    block, (start_block + nb) * block)`` of slot ``slot`` land in pool
+    rows ``ids`` (prompt order). Padded ``ids`` entries point past the
+    pool (``>= P``) and are DROPPED by the scatter, so one compiled
+    program per ``nb`` bucket serves every publish size; the source
+    window clamps at capacity and shifts to compensate (the
+    :func:`_masked_window_write` trick), so clamped garbage rows only
+    ever pair with dropped ids. Returns the updated ``(pool_k, pool_v)``.
+    """
+    nb = ids.shape[0]
+    block = pool_k.shape[3]
+    span = nb * block
+
+    def grab(buf: jax.Array, pool: jax.Array) -> jax.Array:
+        cur = lax.dynamic_index_in_dim(buf, slot, axis=1, keepdims=False)
+        cap = cur.shape[2]
+        s0 = jnp.asarray(start_block, jnp.int32) * block
+        ws = jnp.clip(s0, 0, cap - span)
+        window = lax.dynamic_slice_in_dim(cur, ws, span, axis=2)
+        shift = s0 - ws  # > 0 only when the window straddles capacity
+        rows = jnp.take(
+            window, jnp.arange(span, dtype=jnp.int32) + shift, axis=2,
+            mode="clip",
+        )
+        L, Hkv, _, D = rows.shape
+        rows = jnp.moveaxis(rows.reshape(L, Hkv, nb, block, D), 2, 0)
+        return pool.at[ids].set(rows.astype(pool.dtype), mode="drop")
+
+    return grab(cache_k, pool_k), grab(cache_v, pool_v)
+
+
 def round_cache_len(
     total: int, mesh: Optional[Mesh] = None, seq_axis: str = AXIS_SEQ
 ) -> int:

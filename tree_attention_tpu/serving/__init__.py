@@ -16,3 +16,4 @@ from tree_attention_tpu.serving.engine import (  # noqa: F401
     SlotServer,
     synthetic_trace,
 )
+from tree_attention_tpu.serving.prefix_cache import PrefixCache  # noqa: F401

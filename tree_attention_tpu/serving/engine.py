@@ -45,6 +45,18 @@ Variants:
   One prompt stages at a time; decode ticks never wait for more than a
   chunk of prefill work either way.
 
+- ``prefix_cache=True`` (ISSUE 5) reuses shared prompt prefixes across
+  requests: a host-side radix tree over prompt blocks maps to a
+  device-resident ref-counted KV block pool
+  (:mod:`~tree_attention_tpu.serving.prefix_cache`, RadixAttention,
+  arXiv:2312.07104). On admit, the longest cached prefix is copied
+  pool -> slot (or pool -> staging under int8) with one jitted donated
+  gather and only the unmatched suffix rides the chunk budget; when a
+  prompt's prefill completes, its full blocks are published slot -> pool
+  with one jitted scatter (int8 publishes the exact staged rows, so a
+  later hit re-quantizes under its own frozen scales — the
+  quantize-after-prefill rule survives bit-for-bit).
+
 Works on one device and on a sequence-sharded mesh (the cache is
 seq-sharded; per-slot offsets and chunk windows ride the tree merge
 unchanged).
@@ -158,6 +170,9 @@ class ServeReport:
     mean_occupancy: float  # live slots per executed decode tick
     tbt_s: List[float] = dataclasses.field(default_factory=list)
     slo: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Prefix-reuse accounting for THIS run (diff of the pool's lifetime
+    # stats over the serve() call); empty when the cache is off.
+    prefix: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -193,6 +208,7 @@ class ServeReport:
             **{k: round(v, 4) for k, v in self.completion_percentiles().items()},
             **{k: round(v, 5) for k, v in self.latency_percentiles().items()},
             **({"slo": self.slo} if self.slo else {}),
+            **({"prefix": self.prefix} if self.prefix else {}),
         }
 
 
@@ -206,18 +222,55 @@ def synthetic_trace(
     vocab_size: int = 256,
     seed: int = 0,
     eos_id: Optional[int] = None,
+    prefix_share: float = 0.0,
+    prefix_len: int = 0,
+    prefix_count: int = 1,
+    prefix_seed: Optional[int] = None,
 ) -> List[Request]:
     """A reproducible request trace: random prompts, optional length jitter,
-    arrivals every ``arrival_every`` ticks (0 = all queued at start)."""
+    arrivals every ``arrival_every`` ticks (0 = all queued at start).
+
+    ``prefix_share`` / ``prefix_len`` model production traffic's shared
+    system prompts and templates (the workload the prefix cache exists
+    for): that fraction of requests draws its first ``prefix_len`` tokens
+    from a small fixed set of ``prefix_count`` shared prefixes (round-
+    robin) and only the remainder is per-request random. The shared part
+    is clamped to ``plen - 1`` so every prompt keeps a unique-able
+    suffix token. ``prefix_seed`` draws the SHARED prefixes from their
+    own rng stream, so traces with different ``seed`` values (fresh
+    per-request randomness) can still share one prefix population — the
+    shape a warm-pool steady-state measurement needs; ``None`` keeps
+    everything on the one ``seed`` stream.
+    """
+    if not 0.0 <= prefix_share <= 1.0:
+        raise ValueError(f"prefix_share must be in [0, 1], "
+                         f"got {prefix_share}")
     rng = np.random.default_rng(seed)
+    prefix_rng = rng if prefix_seed is None else \
+        np.random.default_rng(prefix_seed)
+    shared = [
+        prefix_rng.integers(0, vocab_size,
+                            size=max(prefix_len, 0)).astype(np.int32)
+        for _ in range(max(prefix_count, 1))
+    ] if prefix_share > 0.0 and prefix_len > 0 else []
     reqs = []
+    n_shared = 0
     for i in range(n_requests):
         lo = max(1, prompt_len - prompt_jitter)
         hi = prompt_len + prompt_jitter
         plen = int(rng.integers(lo, hi + 1))
+        if shared and rng.random() < prefix_share:
+            p = min(prefix_len, plen - 1)
+            prompt = np.concatenate([
+                shared[n_shared % len(shared)][:p],
+                rng.integers(0, vocab_size, size=plen - p).astype(np.int32),
+            ])
+            n_shared += 1
+        else:
+            prompt = rng.integers(0, vocab_size, size=plen).astype(np.int32)
         reqs.append(Request(
             uid=i,
-            prompt=rng.integers(0, vocab_size, size=plen).astype(np.int32),
+            prompt=prompt,
             max_new_tokens=max_new_tokens,
             arrival_tick=i * arrival_every,
             eos_id=eos_id,
@@ -270,6 +323,13 @@ class SlotServer:
         toward goodput iff its TTFT and worst inter-token gap both met
         the target. The monitor always feeds ``ServeReport.slo``; its
         gauges only publish while the metrics registry records.
+      prefix_cache: enable shared-prompt KV reuse — admissions match
+        their prompt against a radix tree of published prefixes and skip
+        prefill for the matched blocks (one pool gather instead).
+      prefix_block: tokens per prefix pool block (power of two; the
+        match/publish granularity).
+      prefix_pool_blocks: pool capacity in blocks (LRU-evicted at
+        refcount 0).
     """
 
     def __init__(
@@ -290,6 +350,9 @@ class SlotServer:
         slo_ttft: float = 1.0,
         slo_tbt: float = 0.2,
         slo_window: int = 1024,
+        prefix_cache: bool = False,
+        prefix_block: int = 64,
+        prefix_pool_blocks: int = 64,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -374,12 +437,46 @@ class SlotServer:
             ttft_slo=slo_ttft, tbt_slo=slo_tbt, window=slo_window
         )
 
+        # Prefix reuse (ISSUE 5): the radix tree + device block pool, plus
+        # the per-slot ref ledger — nodes a slot matched or published stay
+        # pinned (unevictable) until that slot retires.
+        self._prefix: Optional["PrefixCache"] = None
+        self._slot_nodes: List[List[Any]] = [[] for _ in range(slots)]
+        self._tick_prefix_hits = 0
+        self._tick_prefix_reused = 0
+        if prefix_cache:
+            from tree_attention_tpu.serving.prefix_cache import PrefixCache
+
+            if prefix_block > cache_len:
+                # Checked before the pool allocates: a block wider than a
+                # slot could never be copied anywhere.
+                raise ValueError(
+                    f"prefix_block {prefix_block} exceeds cache_len "
+                    f"{cache_len}"
+                )
+            self._prefix = PrefixCache(
+                cfg, block=prefix_block, blocks=prefix_pool_blocks,
+                mesh=mesh,
+            )
+
+        # Reusable host scratch for the legacy whole-prompt admission's
+        # padded prompt matrix, one per bucket — the chunked path never
+        # allocates per admit, and neither should this one.
+        self._whole_scratch: Dict[int, np.ndarray] = {}
+
         # Quantized + chunked admission stages the exact prefill in ONE
         # preallocated B=1 cache (int8 slots cannot hold exact chunk
         # activations; allocating per admit is the cost this engine
-        # removes). One prompt stages at a time.
+        # removes). One prompt stages at a time. With the prefix cache on,
+        # WHOLE int8 admission routes through the same staging cache too
+        # (the pool stores exact rows; hits land in staging and the
+        # publish reads exact staged rows back out), so it is allocated
+        # for that combination as well.
         self._staged_prefill = quantize and admission == "chunked"
-        if self._staged_prefill:
+        self._needs_staging = quantize and (
+            admission == "chunked" or self._prefix is not None
+        )
+        if self._needs_staging:
             self._staging: KVCache = init_cache(
                 cfg, 1, cache_len, **self._prefill_kw
             )
@@ -397,12 +494,19 @@ class SlotServer:
         self._mixed = jax.jit(self._mixed_fn, donate_argnums=(5,))
         self._prefill = jax.jit(self._prefill_fn)
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0, 1))
-        if self._staged_prefill:
+        if self._needs_staging:
             self._stage_chunk = jax.jit(
                 self._stage_chunk_fn, donate_argnums=(3,)
             )
             self._stage_final = jax.jit(
                 self._stage_final_fn, donate_argnums=(3, 4, 5)
+            )
+        if self._prefix is not None:
+            # Whole-admission prefix hits prefill only the suffix — device-
+            # built single-slot chunks through the SAME mixed-step family
+            # (every other slot rides inert with its parked token intact).
+            self._whole_suffix = jax.jit(
+                self._whole_suffix_fn, donate_argnums=(5,)
             )
 
     # -- compiled pieces --------------------------------------------------
@@ -450,6 +554,26 @@ class SlotServer:
         nxt = self._sample(last, sub)
         nxt = jnp.where(emit, nxt, tokens[:, 0])
         return nxt, new_cache, key
+
+    def _whole_suffix_fn(self, params, rows, slot, n, last, cache,
+                         tok_vec, key):
+        """One suffix chunk of a whole-admission prefix hit: slot ``slot``
+        consumes ``n`` of the ``rows`` (a padded ``(Tq,)`` chunk of its
+        prompt) while every other slot rides inert — their parked tokens
+        pass through untouched because the token matrix is built from the
+        DEVICE token vector (an ``await`` slot's first token only exists
+        there until the next batched fetch). The slot's length was set by
+        the hit gather, so no reset is ever needed. Emits the first
+        sampled token into the token vector on the final chunk."""
+        S, tq = self.slots, rows.shape[0]
+        tokens = jnp.zeros((S, tq), jnp.int32).at[:, 0].set(tok_vec)
+        tokens = lax.dynamic_update_slice(tokens, rows[None, :], (slot, 0))
+        one_hot = jnp.arange(S, dtype=jnp.int32) == slot
+        n_vec = jnp.where(one_hot, n, 0).astype(jnp.int32)
+        emit = one_hot & last
+        reset = jnp.zeros((S,), bool)
+        return self._mixed_fn(params, tokens, n_vec, reset, emit, cache,
+                              key)
 
     def _prefill_fn(self, params, prompt, plen, key):
         """Legacy whole-prompt admission: prefill one request into a fresh
@@ -601,6 +725,10 @@ class SlotServer:
         self._slot_max_tbt[slot] = 0.0
         self._chunk_k[slot] = 0
         self.slo.observe_queue_wait(waited)
+        # Prefix reuse happens FIRST: the matched length decides how much
+        # prompt is left to prefill (and rides the request span below).
+        self._prompt_np[slot] = np.asarray(req.prompt, np.int32)
+        matched = self._prefix_admit(req, slot, tick)
         # The request's life as ONE span (admit -> retire; rid in args so
         # a Perfetto query groups every event of one request), plus an
         # admitted instant on the timeline.
@@ -609,6 +737,8 @@ class SlotServer:
             args=None if not obs.TRACER.active else {
                 "rid": req.uid, "slot": slot, "admit_tick": tick,
                 "prompt_len": len(req.prompt),
+                **({"prefix_hit_len": matched}
+                   if self._prefix is not None else {}),
             },
         )
         if obs.TRACER.active:
@@ -617,12 +747,11 @@ class SlotServer:
                 "queue_wait_s": round(waited, 6),
             })
         if self.admission == "chunked":
-            self._prompt_np[slot] = np.asarray(req.prompt, np.int32)
-            self._prefill_pos[slot] = 0
+            self._prefill_pos[slot] = matched
             self._slot_state[slot] = "prefill"
             self._prefill_fifo.append(slot)
         else:
-            self._admit_whole(req, slot)
+            self._admit_whole(req, slot, matched)
             # First token parked in the device token vector; the slot sits
             # out this tick's step (n=0 holds it) and goes live when the
             # per-tick batched fetch reads it — no per-admit host sync.
@@ -631,12 +760,118 @@ class SlotServer:
             _QUEUE_WAIT.observe(waited)
         return waited
 
-    def _admit_whole(self, req: Request, slot: int) -> None:
-        """Legacy blocking admission: whole-prompt prefill on a
-        bucket-sized mini cache, then insert into the slot's region."""
+    def _prefix_admit(self, req: Request, slot: int, tick: int) -> int:
+        """Match the prompt against the radix tree; on a hit, dispatch the
+        ONE donated pool gather (into the batch slot, or into the staging
+        cache under int8 — pool rows are exact and int8 slots re-quantize
+        at final chunk). Pins the matched path until retire. Returns the
+        matched token count (0 when disabled or cold)."""
+        if self._prefix is None:
+            return 0
+        matched, nodes = self._prefix.match(self._prompt_np[slot])
+        self._slot_nodes[slot] = nodes
+        if not matched:
+            return 0
+        if self.quantize:
+            self._staging = self._prefix.copy_into(
+                self._staging, 0, nodes, matched
+            )
+        else:
+            self.cache = self._prefix.copy_into(
+                self.cache, slot, nodes, matched
+            )
+        self._tick_prefix_hits += 1
+        self._tick_prefix_reused += matched
+        if obs.TRACER.active:
+            obs.instant("prefix_hit", cat="serving", args={
+                "rid": req.uid, "slot": slot, "tick": tick,
+                "matched_tokens": matched,
+                "prompt_len": len(req.prompt),
+            })
+        return matched
+
+    def _publish_prefix(self, slot: int) -> None:
+        """At final-chunk completion: put the prompt's full blocks into
+        the pool (one donated scatter for whatever the tree was missing)
+        and swap the slot's pinned path for the published one. Reads
+        exact rows — the batch cache slot, or the staging cache under
+        int8 (whose rows ARE the exact prefill, pre-quantization)."""
+        if self._prefix is None:
+            return
+        path, new_ids, start = self._prefix.insert(self._prompt_np[slot])
+        if new_ids:
+            if self.quantize:
+                self._prefix.publish_from(self._staging, 0, new_ids, start)
+            else:
+                self._prefix.publish_from(self.cache, slot, new_ids, start)
+        # Insert re-pinned the full path; only then drop the admit-time
+        # refs (a transiently ref-0 matched node could otherwise be
+        # evicted by the insert's own allocations).
+        self._prefix.release(self._slot_nodes[slot])
+        self._slot_nodes[slot] = path
+
+    def _admit_whole(self, req: Request, slot: int, matched: int = 0) -> None:
+        """Blocking admission: the whole remaining prompt prefills before
+        the admit returns (the slot parks in ``await`` either way).
+
+        Three shapes:
+
+        - cold, exact (the legacy path): whole-prompt prefill on a
+          bucket-sized mini cache, then insert into the slot's region;
+        - prefix hit, exact: the gather already placed ``matched`` tokens
+          in the slot, so only the suffix runs — synchronous single-slot
+          chunks through a mixed-step-shaped program (one compile per
+          chunk bucket, same bounded set as the tick's; other slots ride
+          inert);
+        - int8 with the prefix cache on (hit or cold): the staged path
+          runs to completion synchronously — exact chunks into the
+          staging cache, quantize + insert at the final chunk — because
+          both the hit gather and the publish need exact staged rows.
+        """
         plen = len(req.prompt)
+        if self.quantize and self._prefix is not None:
+            self._prefill_pos[slot] = matched
+            pos = matched
+            while pos < plen:
+                n = min(self.prefill_chunk, plen - pos)
+                self._run_staged_chunk(slot, n, pos + n == plen)
+                pos += n  # the final chunk published from staging
+            return
+        if matched:
+            self._prefill_pos[slot] = matched
+            pos = matched
+            while pos < plen:
+                n = min(self.prefill_chunk, plen - pos)
+                last = pos + n == plen
+                rows, _ = self._consume_chunk(slot, n, last)
+                tq = self._chunk_bucket(n)
+                # Same no-per-admit-alloc discipline as the cold path's
+                # scratch below, keyed by (1, tq) row shape.
+                pad = self._whole_scratch.get(tq)
+                if pad is None:
+                    pad = self._whole_scratch[tq] = np.zeros((1, tq),
+                                                             np.int32)
+                else:
+                    pad[0, n:] = 0
+                pad[0, :n] = rows
+                self.tok, self.cache, self._key = self._whole_suffix(
+                    self.params, jnp.asarray(pad[0]), jnp.int32(slot),
+                    jnp.int32(n), jnp.asarray(last), self.cache, self.tok,
+                    self._key,
+                )
+                pos += n
+            self._publish_prefix(slot)
+            return
         bucket = _bucket(plen, self.cache_len, multiple=self._seq_shards)
-        padded = np.zeros((1, bucket), np.int32)
+        # Reusable per-bucket scratch: zero the tail a longer previous
+        # occupant may have left, then lay the prompt in — jnp.asarray
+        # copies to a fresh device buffer, so immediate reuse is safe.
+        padded = self._whole_scratch.get(bucket)
+        if padded is None:
+            padded = self._whole_scratch[bucket] = np.zeros((1, bucket),
+                                                            np.int32)
+        else:
+            padded[0, plen:] = 0
         padded[0, :plen] = np.asarray(req.prompt, np.int32)
         self._key, sub = jax.random.split(self._key)
         payload = self._prefill(self.params, jnp.asarray(padded),
@@ -644,6 +879,8 @@ class SlotServer:
         self.cache, self.tok = self._insert(
             self.cache, self.tok, jnp.int32(slot), payload, plen
         )
+        if self._prefix is not None:
+            self._publish_prefix(slot)
 
     def _plan_chunks(self) -> List[Tuple[int, int, bool]]:
         """Sarathi-style budget pass: FIFO over prefilling slots, each
@@ -677,7 +914,8 @@ class SlotServer:
         self._chunk_k[slot] += 1
         if last:
             self._slot_state[slot] = "await"
-            self._prefill_fifo.remove(slot)
+            if slot in self._prefill_fifo:  # whole-admission suffix
+                self._prefill_fifo.remove(slot)  # chunks never enqueue
         if obs.REGISTRY.enabled:
             _PREFILL_CHUNKS.inc()
         if obs.TRACER.active:
@@ -708,6 +946,10 @@ class SlotServer:
                 self.cache, self.tok, jnp.int32(slot), jnp.int32(plen),
                 reset, sub,
             )
+            # The staging cache now holds the prompt's EXACT rows (the
+            # quantized copy went into the slot) — publish before the
+            # next prompt overwrites them.
+            self._publish_prefix(slot)
         else:
             self._staging = self._stage_chunk(
                 self.params, jnp.asarray(mat), n_vec, self._staging, reset
@@ -750,6 +992,10 @@ class SlotServer:
         self._slot_tokens[slot] = []
         self._slot_state[slot] = "free"
         self._prompt_np[slot] = None
+        if self._prefix is not None and self._slot_nodes[slot]:
+            # The request's pinned prefix path becomes evictable.
+            self._prefix.release(self._slot_nodes[slot])
+            self._slot_nodes[slot] = []
         if obs.REGISTRY.enabled:
             _REQUESTS.labels(outcome=outcome).inc()
 
@@ -770,6 +1016,7 @@ class SlotServer:
         decode_ticks = 0
         occupancy = 0
         tokens = 0
+        prefix0 = self._prefix.stats() if self._prefix is not None else None
         t0 = time.monotonic()
 
         try:
@@ -780,6 +1027,8 @@ class SlotServer:
                         f"{len(pending)} pending request(s)"
                     )
                 now = time.monotonic()
+                self._tick_prefix_hits = 0
+                self._tick_prefix_reused = 0
                 visible = 0
                 for r in pending:  # sorted by arrival_tick — stop at future
                     if r.arrival_tick > tick:
@@ -866,6 +1115,13 @@ class SlotServer:
                             jnp.asarray(emit), self.cache, self._key,
                         )
                         stepped = True
+                        if self._prefix is not None:
+                            # Final chunks just completed their prompts in
+                            # the batch cache — publish the new blocks
+                            # while this admission's rows are fresh.
+                            for slot, n, last in plan:
+                                if last:
+                                    self._publish_prefix(slot)
                     elif live_idx:
                         # Pure-decode tick: the SAME program at the Tq=1
                         # bucket, tokens carried on device (awaiting slots
@@ -975,6 +1231,8 @@ class SlotServer:
                         "host_sync": host_sync,
                         "queue_depth": queue_depth,
                         "pending": len(pending),
+                        "prefix_hits": self._tick_prefix_hits,
+                        "prefix_reused": self._tick_prefix_reused,
                     })
                 self.slo.maybe_export(now)
 
@@ -1010,6 +1268,21 @@ class SlotServer:
         # the report carries the windowed snapshot (goodput + percentiles).
         self.slo.export_gauges()
         slo_snap = self.slo.snapshot()
+        prefix_snap: Dict[str, Any] = {}
+        if self._prefix is not None:
+            p1 = self._prefix.stats()
+            reused = p1["tokens_reused"] - prefix0["tokens_reused"]
+            prompt_tokens = sum(r.prompt_len for r in results)
+            prefix_snap = {
+                "hits": p1["hits"] - prefix0["hits"],
+                "misses": p1["misses"] - prefix0["misses"],
+                "tokens_reused": reused,
+                "reused_ratio": round(reused / prompt_tokens, 4)
+                if prompt_tokens else 0.0,
+                "evictions": p1["evictions"] - prefix0["evictions"],
+                "pool_blocks_used": p1["pool_blocks_used"],
+                "pool_blocks": p1["pool_blocks"],
+            }
         log.info(
             "served %d request(s): %d tokens over %d decode tick(s), "
             "%.1f tok/s, mean occupancy %.2f/%d",
@@ -1025,4 +1298,5 @@ class SlotServer:
             mean_occupancy=occupancy / max(decode_ticks, 1),
             tbt_s=tbt,
             slo=slo_snap,
+            prefix=prefix_snap,
         )

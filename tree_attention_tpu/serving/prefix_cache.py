@@ -1,0 +1,321 @@
+"""Radix prefix KV cache: shared-prompt reuse across serving requests.
+
+Production request streams are dominated by shared prompt prefixes —
+system prompts, few-shot templates, multi-turn histories — yet a plain
+slot server re-runs full prefill for every admission, paying Tree-
+Attention prefill compute for tokens whose KV rows already sit on the
+device. RadixAttention (Zheng et al., *SGLang*, arXiv:2312.07104) showed
+that a radix tree over prompt token sequences, mapping prefixes to cached
+KV blocks, turns that duplicate prefill into a gather. This module is
+that idea fitted to the slot engine's contracts:
+
+- **Host-side radix tree** at ``block``-token granularity (power of two,
+  bucket-friendly): each node owns ONE pool block — the KV rows of one
+  ``block``-token span — keyed by that span's token tuple under its
+  parent. A path from the root spells a prompt prefix; matching is a walk.
+- **Device-resident block pool**: preallocated ``(P, L, Hkv, block, D)``
+  K and V buffers (exact model dtype — int8 slots re-quantize on insert
+  under their own frozen scales, so the pool must keep exact rows).
+  Copies in and out are ONE jitted donated gather/scatter each
+  (:func:`~tree_attention_tpu.models.decode.insert_prefix_blocks` /
+  :func:`~tree_attention_tpu.models.decode.extract_prefix_blocks`), with
+  the block-count ``nb`` padded to a small power-of-two bucket set so no
+  hit or publish size ever recompiles.
+- **Ref-counted LRU eviction**: a node is pinned (``refs > 0``) from the
+  admission that matched or published it until that request retires;
+  eviction only ever takes a refcount-0 *leaf* (evicting an interior node
+  would orphan its children's prefix), least-recently-used first. The
+  pool can therefore never over-commit and never frees a block a request
+  still depends on — the property test in
+  ``tests/test_serving_prefix.py`` hammers exactly this.
+
+Matches are capped at ``len(prompt) - 1`` tokens (rounded down to the
+block size): the suffix must keep at least one token, because sampling
+the first output token needs at least one forward row. Under a mesh the
+pool is **replicated** — pool blocks land at arbitrary token offsets of a
+sequence-sharded cache, so no static sharding of the block axis can stay
+aligned with its destination shard; replication keeps the gather local
+per shard (the pool is small next to the slot cache it feeds).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tree_attention_tpu import obs
+from tree_attention_tpu.models.decode import (
+    KVCache,
+    extract_prefix_blocks,
+    insert_prefix_blocks,
+)
+from tree_attention_tpu.models.transformer import TransformerConfig
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("serving.prefix")
+
+# Prefix-reuse observability (ISSUE 5). Hit/miss/reuse counters are
+# host-loop truths recorded at admission; the occupancy gauge tracks the
+# pool allocator. All guarded: allocation-free when the registry is off.
+_HITS = obs.counter(
+    "serving_prefix_hits_total",
+    "admissions that matched a cached prompt prefix",
+)
+_MISSES = obs.counter(
+    "serving_prefix_misses_total",
+    "admissions that found no cached prefix (cold prefill)",
+)
+_TOKENS_REUSED = obs.counter(
+    "serving_prefix_tokens_reused_total",
+    "prompt tokens whose prefill was replaced by a pool gather",
+)
+_POOL_USED = obs.gauge(
+    "serving_prefix_pool_blocks_used",
+    "prefix pool blocks currently holding a cached KV span",
+)
+
+
+class _Node:
+    """One radix node: a ``block``-token span owning one pool block."""
+
+    __slots__ = ("key", "parent", "children", "block_id", "refs", "last_use")
+
+    def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"],
+                 block_id: int):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.block_id = block_id
+        self.refs = 0
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Device block pool + host radix tree over prompt prefixes.
+
+    Args:
+      cfg: the served model (fixes the pool's ``(L, Hkv, D)`` and dtype).
+      block: tokens per pool block (power of two; matches/publishes happen
+        at this granularity).
+      blocks: pool capacity ``P`` in blocks.
+      mesh: replicate the pool over this mesh (see module docstring).
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        *,
+        block: int = 64,
+        blocks: int = 64,
+        mesh: Optional[Mesh] = None,
+    ):
+        if block < 1 or block & (block - 1):
+            raise ValueError(f"prefix block must be a power of two, "
+                             f"got {block}")
+        if blocks < 1:
+            raise ValueError(f"prefix pool needs >= 1 block, got {blocks}")
+        self.block = block
+        self.blocks = blocks
+        shape = (blocks, cfg.n_layers, cfg.n_kv_heads, block, cfg.d_head)
+        if mesh is not None:
+            sharding = NamedSharding(mesh, P())  # replicated (see above)
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, cfg.dtype), out_shardings=sharding
+            )
+            self.pool_k = zeros()
+            self.pool_v = zeros()
+        else:
+            self.pool_k = jnp.zeros(shape, cfg.dtype)
+            self.pool_v = jnp.zeros(shape, cfg.dtype)
+        self._root = _Node((), None, -1)
+        self._free: List[int] = list(range(blocks))
+        self._clock = 0
+        # Run/lifetime stats (host truths; the engine snapshots + diffs
+        # these per serve() run for its report).
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+        self._copy = jax.jit(insert_prefix_blocks, donate_argnums=(0,))
+        self._publish = jax.jit(extract_prefix_blocks, donate_argnums=(0, 1))
+
+    # -- host radix tree --------------------------------------------------
+
+    @property
+    def blocks_used(self) -> int:
+        return self.blocks - len(self._free)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "tokens_reused": self.tokens_reused,
+            "evictions": self.evictions,
+            "pool_blocks_used": self.blocks_used,
+            "pool_blocks": self.blocks,
+        }
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    def _key(self, prompt: np.ndarray, j: int) -> Tuple[int, ...]:
+        return tuple(
+            int(t) for t in prompt[j * self.block:(j + 1) * self.block]
+        )
+
+    def match(self, prompt: np.ndarray) -> Tuple[int, List[_Node]]:
+        """Longest cached prefix of ``prompt`` in whole blocks, capped so
+        at least one suffix token remains. Returns ``(matched_tokens,
+        path)`` with every path node ref-pinned and LRU-touched — the
+        caller owns the refs until it calls :meth:`release` (the serving
+        engine holds them for the request's lifetime)."""
+        max_blocks = (len(prompt) - 1) // self.block
+        node = self._root
+        path: List[_Node] = []
+        for j in range(max_blocks):
+            child = node.children.get(self._key(prompt, j))
+            if child is None:
+                break
+            child.refs += 1
+            self._touch(child)
+            path.append(child)
+            node = child
+        matched = len(path) * self.block
+        if matched:
+            self.hits += 1
+            self.tokens_reused += matched
+            if obs.REGISTRY.enabled:
+                _HITS.inc()
+                _TOKENS_REUSED.inc(matched)
+        else:
+            self.misses += 1
+            if obs.REGISTRY.enabled:
+                _MISSES.inc()
+        return matched, path
+
+    def release(self, nodes: List[_Node]) -> None:
+        for n in nodes:
+            n.refs -= 1
+            assert n.refs >= 0, "prefix node ref underflow"
+
+    def insert(self, prompt: np.ndarray) -> Tuple[List[_Node], List[int],
+                                                  int]:
+        """Ensure nodes exist for ``prompt``'s full-block prefix.
+
+        Walks/extends the tree, allocating pool blocks (evicting LRU
+        refcount-0 leaves as needed) for the missing tail; stops early —
+        partial paths are valid prefixes — when the pool is fully pinned.
+        Every path node is ref-pinned as it is visited, so an eviction
+        triggered later in the same insert can never take an earlier path
+        node. Returns ``(path, new_ids, start_block)``: the ref-held
+        path, the freshly allocated pool rows still needing KV data, and
+        the block index their data starts at.
+        """
+        nb_full = len(prompt) // self.block
+        node = self._root
+        path: List[_Node] = []
+        j = 0
+        while j < nb_full:
+            child = node.children.get(self._key(prompt, j))
+            if child is None:
+                break
+            child.refs += 1
+            self._touch(child)
+            path.append(child)
+            node = child
+            j += 1
+        start = j
+        new_ids: List[int] = []
+        while j < nb_full:
+            bid = self._alloc()
+            if bid is None:
+                log.debug("prefix pool pinned full; publish stops at "
+                          "block %d/%d", j, nb_full)
+                break
+            child = _Node(self._key(prompt, j), node, bid)
+            child.refs = 1
+            self._touch(child)
+            node.children[child.key] = child
+            path.append(child)
+            new_ids.append(bid)
+            node = child
+            j += 1
+        return path, new_ids, start
+
+    def _alloc(self) -> Optional[int]:
+        if not self._free:
+            victim = self._lru_leaf()
+            if victim is None:
+                return None
+            self._evict(victim)
+        bid = self._free.pop()
+        if obs.REGISTRY.enabled:
+            _POOL_USED.set(self.blocks_used)
+        return bid
+
+    def _lru_leaf(self) -> Optional[_Node]:
+        """The least-recently-used refcount-0 leaf, or None when every
+        block is pinned (directly or through a pinned descendant)."""
+        best: Optional[_Node] = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.children or n.refs:
+                continue
+            if best is None or n.last_use < best.last_use:
+                best = n
+        return best
+
+    def _evict(self, node: _Node) -> None:
+        assert not node.children and node.refs == 0
+        del node.parent.children[node.key]
+        self._free.append(node.block_id)
+        self.evictions += 1
+        if obs.REGISTRY.enabled:
+            _POOL_USED.set(self.blocks_used)
+
+    # -- device copies ----------------------------------------------------
+
+    def _nb_bucket(self, n: int, capacity: int) -> int:
+        """Power-of-two block-count bucket, capped so the copy window fits
+        the cache (``nb * block <= capacity``) — the small fixed set of
+        compiled gather/scatter programs. The ONE bucket rule is the
+        engine's :func:`~tree_attention_tpu.serving.engine._bucket`."""
+        from tree_attention_tpu.serving.engine import _bucket
+
+        return _bucket(n, capacity // self.block, floor=1)
+
+    def copy_into(self, cache: KVCache, slot: int, nodes: List[_Node],
+                  matched: int) -> KVCache:
+        """The hit path: one jitted donated gather placing ``matched``
+        pooled tokens at offset 0 of ``slot`` (length set to ``matched``).
+        ``cache`` must be an exact :class:`KVCache` (the batch slot cache,
+        or the B=1 staging cache under int8 serving)."""
+        nb = self._nb_bucket(len(nodes), cache.capacity)
+        ids = np.zeros((nb,), np.int32)  # pad gathers block 0; rows masked
+        ids[:len(nodes)] = [n.block_id for n in nodes]
+        return self._copy(
+            cache, self.pool_k, self.pool_v, jnp.asarray(ids),
+            jnp.int32(matched), jnp.int32(slot),
+        )
+
+    def publish_from(self, cache: KVCache, slot: int, new_ids: List[int],
+                     start_block: int) -> None:
+        """The publish path: one jitted donated scatter copying the slot's
+        freshly prefilled blocks ``[start_block, start_block + len(new_ids))``
+        into their pool rows (padded ids point past the pool and drop)."""
+        if not new_ids:
+            return
+        nb = self._nb_bucket(len(new_ids), cache.capacity)
+        ids = np.full((nb,), self.blocks, np.int32)  # OOB pad -> dropped
+        ids[:len(new_ids)] = new_ids
+        self.pool_k, self.pool_v = self._publish(
+            self.pool_k, self.pool_v, cache.k, cache.v,
+            jnp.int32(slot), jnp.asarray(ids), jnp.int32(start_block),
+        )

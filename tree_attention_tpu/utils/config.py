@@ -88,6 +88,11 @@ class RunConfig:
     admission: str = "chunked"  # "chunked" (stall-free) | "whole" (legacy)
     slo_ttft: float = 1.0    # TTFT target (s) for the goodput SLO
     slo_tbt: float = 0.2     # worst inter-token-gap target (s), ditto
+    prefix_cache: bool = False  # radix prefix KV reuse across requests
+    prefix_block: int = 64   # pool block granularity (tokens, pow2)
+    prefix_pool_blocks: int = 64  # device pool capacity in blocks
+    prefix_share: float = 0.0  # trace: fraction of requests sharing a prefix
+    prefix_len: int = 0      # trace: shared prefix length (tokens)
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -258,6 +263,26 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    metavar="SEC",
                    help="serve mode: worst-inter-token-gap target of the "
                         "goodput SLO (see --slo-ttft)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   default=d.prefix_cache,
+                   help="serve mode: enable the radix prefix KV cache — "
+                        "admissions reuse KV blocks of previously served "
+                        "prompt prefixes (one pool gather replaces their "
+                        "prefill; RadixAttention, arXiv:2312.07104)")
+    p.add_argument("--prefix-block", type=int, default=d.prefix_block,
+                   help="serve mode: prefix pool block size in tokens "
+                        "(power of two; the match/publish granularity)")
+    p.add_argument("--prefix-pool-blocks", type=int,
+                   default=d.prefix_pool_blocks,
+                   help="serve mode: prefix pool capacity in blocks "
+                        "(refcount-0 blocks are LRU-evicted)")
+    p.add_argument("--prefix-share", type=float, default=d.prefix_share,
+                   help="serve mode: fraction of the synthetic trace's "
+                        "requests drawing their prompt head from a shared "
+                        "prefix (models shared system prompts)")
+    p.add_argument("--prefix-len", type=int, default=d.prefix_len,
+                   help="serve mode: length of the trace's shared prefix "
+                        "in tokens (0 = no sharing)")
     p.add_argument("--host-data", action="store_true", default=d.host_data,
                    help="train mode: feed batches from the native prefetching "
                         "host pipeline instead of on-device RNG")
