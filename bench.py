@@ -635,6 +635,19 @@ def _serving_prefix_record():
     return bench_serving_prefix_flood()
 
 
+def _serving_paged_record():
+    """Paged KV flood (ISSUE 6): paged vs contiguous layouts at EQUAL
+    pool bytes over the PR-5 shared-prefix flood — the chain_slope-priced
+    pool->slot gather vs the host table update that replaces it on a
+    paged hit (bytes_moved == 0), TTFT p50/p95 for both layouts, and
+    max concurrent requests when the paged pool is over-subscribed
+    (PagedAttention, arXiv:2309.06180). CPU proxy; the zero-copy and
+    capacity structure transfers. See tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_paged_flood
+
+    return bench_serving_paged_flood()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -867,6 +880,7 @@ def _run_suite() -> None:
     run("serving_continuous_batching", _serving_record)
     run("serving_chunked_prefill_flood", _serving_flood_record)
     run("serving_prefix_flood", _serving_prefix_record)
+    run("serving_paged_flood", _serving_paged_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -978,6 +992,17 @@ def _summarize_record(name, rec):
         reused = trace.get("on", {}).get("tokens_reused_ratio")
         if reused is not None:
             out["tokens_reused_ratio"] = reused
+    if name == "serving_paged_flood":
+        slope = rec.get("slope", {})
+        if "gather_avoided_ratio" in slope:
+            out["gather_avoided_ratio"] = slope["gather_avoided_ratio"]
+        trace = rec.get("trace", {})
+        for key in ("ttft_p50_improvement", "max_concurrent_improvement"):
+            if key in trace:
+                out[key] = trace[key]
+        moved = trace.get("paged", {}).get("hit_bytes_moved")
+        if moved is not None:
+            out["paged_hit_bytes_moved"] = moved
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

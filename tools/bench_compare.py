@@ -56,7 +56,7 @@ EXACT = "exact"
 _LARGER_SUBSTRINGS = (
     "tokens_per_sec", "flops_per_sec", "speedup", "improvement",
     "goodput", "roofline_frac", "stall_ratio", "avoided_ratio",
-    "reused_ratio", "hit_rate",
+    "reused_ratio", "hit_rate", "max_concurrent",
 )
 _EXACT_SUFFIXES = ("_total", "_bytes", "_count")
 _SMALLER_SUFFIXES = ("_us", "_s", "_seconds", "_ms")

@@ -485,8 +485,31 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     if cfg.prefix_cache and (cfg.prefix_block < 1
                              or cfg.prefix_block & (cfg.prefix_block - 1)):
         raise SystemExit("--prefix-block must be a power of two >= 1")
-    if cfg.prefix_cache and cfg.prefix_pool_blocks < 1:
+    if cfg.prefix_cache and cfg.prefix_pool_blocks is not None \
+            and cfg.prefix_pool_blocks < 1:
         raise SystemExit("--prefix-pool-blocks must be >= 1")
+    if cfg.kv_block is not None and (cfg.kv_block < 1
+                                     or cfg.kv_block & (cfg.kv_block - 1)):
+        raise SystemExit("--kv-block must be a power of two >= 1")
+    if cfg.kv_blocks is not None and cfg.kv_blocks < 1:
+        raise SystemExit("--kv-blocks must be >= 1")
+    if cfg.kv_layout == "paged" and cfg.prefix_cache \
+            and cfg.kv_block is not None and cfg.kv_block != cfg.prefix_block:
+        # The engine enforces this too (radix matching happens at page
+        # granularity); surface it as the clean flag-error every other
+        # serve-mode misuse gets, not a traceback.
+        raise SystemExit(
+            f"--prefix-block {cfg.prefix_block} must equal --kv-block "
+            f"{cfg.kv_block} under --kv-layout paged (or pass only one "
+            f"of them)"
+        )
+    if cfg.kv_layout == "contiguous" and (cfg.kv_block is not None
+                                          or cfg.kv_blocks is not None):
+        log.warning(
+            "--kv-block/--kv-blocks only apply to --kv-layout paged; "
+            "the contiguous layout allocates slots * cache_len and a "
+            "separate prefix pool (the flags are ignored)"
+        )
     # The cache is sized from the trace itself: longest possible prompt
     # plus the per-request budget, through the same rounding rule
     # generate() uses.
@@ -520,6 +543,34 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     )
     if cfg.slo_ttft <= 0 or cfg.slo_tbt <= 0:
         raise SystemExit("--slo-ttft and --slo-tbt must be > 0")
+    # Deprecation shim (ISSUE 6): --prefix-pool-blocks described the OLD
+    # memory split (slots * cache_len of slot cache PLUS a separate
+    # prefix pool). Under the paged layout there is one --kv-blocks
+    # budget; map the old flag onto it at the equal-total-bytes point so
+    # existing invocations keep their memory footprint.
+    kv_blocks = cfg.kv_blocks
+    prefix_pool_blocks = cfg.prefix_pool_blocks
+    if cfg.prefix_pool_blocks is not None and cfg.kv_layout == "paged":
+        kv_block = cfg.kv_block or (
+            cfg.prefix_block if cfg.prefix_cache else 64
+        )
+        if kv_blocks is None:
+            kv_blocks = (
+                cfg.slots * (-(-cache_len // kv_block))
+                + cfg.prefix_pool_blocks
+            )
+            log.warning(
+                "--prefix-pool-blocks is deprecated under the paged KV "
+                "layout: its %d blocks were folded into the unified "
+                "--kv-blocks budget (now %d). Pass --kv-blocks directly.",
+                cfg.prefix_pool_blocks, kv_blocks,
+            )
+        else:
+            log.warning(
+                "--prefix-pool-blocks is deprecated and ignored when "
+                "--kv-blocks is given (the paged pool is ONE budget)"
+            )
+        prefix_pool_blocks = None  # no separate retention cap from the CLI
     server = SlotServer(
         params, tcfg,
         slots=cfg.slots, cache_len=cache_len, mesh=mesh,
@@ -533,7 +584,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         slo_tbt=cfg.slo_tbt,
         prefix_cache=cfg.prefix_cache,
         prefix_block=cfg.prefix_block,
-        prefix_pool_blocks=cfg.prefix_pool_blocks,
+        prefix_pool_blocks=prefix_pool_blocks,
+        kv_layout=cfg.kv_layout,
+        kv_block=cfg.kv_block,
+        kv_blocks=kv_blocks,
     )
     from tree_attention_tpu.host_runtime import heartbeat
 
@@ -552,9 +606,11 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         "cache_len": cache_len,
         "admission": cfg.admission,
         "prefill_chunk": cfg.prefill_chunk,
+        "kv_layout": cfg.kv_layout,
         **({"prefix_cache": {
             "block": cfg.prefix_block,
-            "pool_blocks": cfg.prefix_pool_blocks,
+            **({"pool_blocks": prefix_pool_blocks}
+               if prefix_pool_blocks is not None else {}),
         }} if cfg.prefix_cache else {}),
         **report.as_dict(),
         "outcomes": {

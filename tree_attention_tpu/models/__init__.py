@@ -17,11 +17,14 @@ from tree_attention_tpu.models.transformer import (  # noqa: F401
 )
 from tree_attention_tpu.models.decode import (  # noqa: F401
     KVCache,
+    PagedKVCache,
+    PagedQuantKVCache,
     QuantKVCache,
     decode_attention,
     forward_step,
     generate,
     init_cache,
+    init_paged_cache,
     quantize_cache,
 )
 from tree_attention_tpu.models.train import (  # noqa: F401

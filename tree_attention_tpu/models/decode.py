@@ -105,6 +105,79 @@ class KVCache:
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
+class PagedKVCache:
+    """Paged KV: one block pool, per-slot block tables (PagedAttention).
+
+    The contiguous :class:`KVCache` pins capacity at ``B × Tmax`` whether
+    slots are full or empty; the paged layout (vLLM's PagedAttention,
+    arXiv:2309.06180) stores KV in a single pool of ``N`` fixed-size
+    blocks — ``k``/``v`` are ``(L, N, Hkv, block, D)`` — and each slot is
+    a **block table** row: ``table[i, j]`` names the physical pool block
+    holding slot ``i``'s tokens ``[j·block, (j+1)·block)``. Slot capacity
+    is logical (``NB · block`` via the table width); physical blocks are
+    allocated on demand by the host-side allocator
+    (:mod:`tree_attention_tpu.serving.block_pool`), so total memory is
+    ``N`` blocks regardless of slot count, and two slots may map the SAME
+    physical block (copy-free shared prefixes — a radix-cache hit is a
+    table write, not a gather). Unwritten table entries must stay at a
+    valid pool index (0): the causal mask hides every position past
+    ``length[i]``, so a garbage block is never *visible*, but the gather
+    and the Pallas index maps still dereference it.
+    """
+
+    k: jax.Array       # (L, N, Hkv, block, D) pool
+    v: jax.Array       # (L, N, Hkv, block, D) pool
+    table: jax.Array   # (B, NB) int32 — physical block per logical block
+    length: jax.Array  # (B,) int32 — tokens written so far, per slot
+
+    @property
+    def capacity(self) -> int:
+        return self.table.shape[1] * self.k.shape[3]
+
+    @property
+    def block(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def blocks(self) -> int:
+        return self.k.shape[1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedQuantKVCache:
+    """int8 paged KV: int8 block pools + per-SLOT frozen scales.
+
+    Scales stay per slot (``(L, B, Hkv, 1, D)``), not per block — the
+    quantize-after-prefill contract freezes one scale set per request's
+    prefill, and every block a slot writes is quantized under that slot's
+    scales. int8 blocks therefore cannot be shared between slots (two
+    slots' scales differ), so the prefix cache keeps its exact-dtype
+    sidecar pool under int8 serving (see ``serving/prefix_cache.py``).
+    """
+
+    k: jax.Array        # (L, N, Hkv, block, D) int8 pool
+    v: jax.Array        # (L, N, Hkv, block, D) int8 pool
+    k_scale: jax.Array  # (L, B, Hkv, 1, D) float32 — per slot
+    v_scale: jax.Array  # (L, B, Hkv, 1, D) float32 — per slot
+    table: jax.Array    # (B, NB) int32
+    length: jax.Array   # (B,) int32
+
+    @property
+    def capacity(self) -> int:
+        return self.table.shape[1] * self.k.shape[3]
+
+    @property
+    def block(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def blocks(self) -> int:
+        return self.k.shape[1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
 class QuantKVCache:
     """int8 per-layer KV buffers with frozen per-channel scales.
 
@@ -203,6 +276,158 @@ def init_cache(
     return KVCache(k=k, v=v, length=jnp.zeros((batch_size,), jnp.int32))
 
 
+def init_paged_cache(
+    cfg: TransformerConfig,
+    batch_size: int,
+    max_len: int,
+    blocks: int,
+    *,
+    block: int = 64,
+    mesh: Optional[Mesh] = None,
+    quantize: bool = False,
+) -> Union[PagedKVCache, PagedQuantKVCache]:
+    """Allocate a paged cache: one ``blocks``-block pool + empty tables.
+
+    ``max_len`` is the logical per-slot capacity (rounded up to a whole
+    number of blocks — the table width); ``blocks`` is the POOL capacity
+    shared by every slot, which may be far less than
+    ``batch_size × max_len`` tokens (the point of paging). Under a mesh
+    the pool is **replicated**: table entries place blocks at arbitrary
+    token offsets, so no static sharding of the block axis can stay
+    aligned with a sequence shard (same argument as the prefix pool).
+    ``quantize`` allocates int8 pools with per-slot unit scales — the
+    same empty-cache fallback :func:`quantize_cache` produces, so a
+    paged and a contiguous int8 server start bit-identical.
+    """
+    if block < 1 or block & (block - 1):
+        raise ValueError(f"kv block must be a power of two, got {block}")
+    if blocks < 1:
+        raise ValueError(f"paged pool needs >= 1 block, got {blocks}")
+    nb = -(-max_len // block)
+    shape = (cfg.n_layers, blocks, cfg.n_kv_heads, block, cfg.d_head)
+    dtype = jnp.int8 if quantize else cfg.dtype
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P())  # replicated (see above)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+        )
+        k = zeros()
+        v = zeros()
+    else:
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+    table = jnp.zeros((batch_size, nb), jnp.int32)
+    length = jnp.zeros((batch_size,), jnp.int32)
+    if obs.REGISTRY.enabled:
+        _CACHE_CAPACITY.set(nb * block)
+        _CACHE_ALLOCS.labels(sharded=str(mesh is not None).lower()).inc()
+    if quantize:
+        sshape = (cfg.n_layers, batch_size, cfg.n_kv_heads, 1, cfg.d_head)
+        return PagedQuantKVCache(
+            k=k, v=v,
+            # Two distinct buffers: the engine's donating steps may not
+            # alias k_scale and v_scale.
+            k_scale=jnp.ones(sshape, jnp.float32),
+            v_scale=jnp.ones(sshape, jnp.float32),
+            table=table, length=length,
+        )
+    return PagedKVCache(k=k, v=v, table=table, length=length)
+
+
+def _paged_pool_write(
+    pool: jax.Array,
+    rows: jax.Array,
+    table: jax.Array,
+    start: jax.Array,
+    n: jax.Array,
+) -> jax.Array:
+    """Scatter each slot's new token rows through its block table.
+
+    One layer's piece of the paged mixed-Tq step: ``pool`` is
+    ``(N, Hkv, block, D)``, ``rows`` ``(B, Hkv, Tq, D)``, ``start``/``n``
+    per-slot ``(B,)`` vectors. Token ``j`` of slot ``i`` (valid iff
+    ``j < n[i]``) lands at physical block ``table[i, (start[i]+j)//block]``
+    row ``(start[i]+j) % block``; invalid rows scatter to index ``N`` and
+    DROP, so the paged write needs none of the contiguous path's
+    clamp-and-shift machinery — ragged and near-capacity cases fall out
+    of the drop semantics. Distinct slots never share a *writable* block
+    (shared prefix blocks sit below ``start``), so indices never collide.
+    """
+    N, _, block, _ = pool.shape
+    B, Hkv, Tq, D = rows.shape
+    pos = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)[None, :]  # (B, Tq)
+    lb = jnp.clip(pos // block, 0, table.shape[1] - 1)
+    pb = jnp.take_along_axis(table, lb, axis=1)
+    valid = (
+        (jnp.arange(Tq, dtype=jnp.int32)[None, :] < n[:, None])
+        # Over-capacity safety: the contiguous path RAISES on overflow
+        # eagerly; under jit this mask keeps a buggy caller's overflow
+        # from landing in another slot's pool block through the clipped
+        # table index above.
+        & (pos < table.shape[1] * block)
+    )
+    pb = jnp.where(valid, pb, N)  # OOB -> dropped
+    flat = jnp.moveaxis(rows, 2, 1).reshape(B * Tq, Hkv, D)
+    return pool.at[pb.reshape(-1), :, (pos % block).reshape(-1), :].set(
+        flat.astype(pool.dtype), mode="drop"
+    )
+
+
+def paged_insert_slot(
+    cache: Union[PagedKVCache, PagedQuantKVCache],
+    slot: jax.Array,
+    k_rows: jax.Array,
+    v_rows: jax.Array,
+    plen: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> Union[PagedKVCache, PagedQuantKVCache]:
+    """Place a B=1 prefilled cache's rows into one slot's mapped blocks.
+
+    The paged mirror of the engine's contiguous insert: ``k_rows`` /
+    ``v_rows`` are ``(L, 1, Hkv, T, D)`` (a mini/staging cache, possibly
+    already int8), token positions ``[0, plen)`` scatter through the
+    slot's table row (``plen`` may be traced; rows past it drop), the
+    slot's ``length`` becomes ``plen``, and — for a quantized cache —
+    the slot's frozen scales are installed. The caller must have mapped
+    blocks covering ``[0, plen)`` in the table first.
+    """
+    L, _, Hkv, T, D = k_rows.shape
+    N, block = cache.blocks, cache.block
+    row = lax.dynamic_index_in_dim(cache.table, slot, axis=0, keepdims=False)
+    pos = jnp.arange(T, dtype=jnp.int32)
+    lb = jnp.clip(pos // block, 0, row.shape[0] - 1)
+    # Rows past plen AND past the slot's logical capacity both drop
+    # (same over-capacity safety as _paged_pool_write).
+    ok = (pos < plen) & (pos < row.shape[0] * block)
+    pb = jnp.where(ok, jnp.take(row, lb), N)  # OOB -> dropped
+    off = pos % block
+
+    def put(pool: jax.Array, rows: jax.Array) -> jax.Array:
+        vals = jnp.moveaxis(rows[:, 0], 2, 0)  # (T, L, Hkv, D)
+        return pool.at[:, pb, :, off, :].set(
+            vals.astype(pool.dtype), mode="drop"
+        )
+
+    length = lax.dynamic_update_index_in_dim(
+        cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
+    )
+    if isinstance(cache, PagedQuantKVCache):
+        put_s = lambda buf, new: lax.dynamic_update_index_in_dim(
+            buf, new[:, 0], slot, axis=1
+        )
+        return PagedQuantKVCache(
+            k=put(cache.k, k_rows), v=put(cache.v, v_rows),
+            k_scale=put_s(cache.k_scale, k_scale),
+            v_scale=put_s(cache.v_scale, v_scale),
+            table=cache.table, length=length,
+        )
+    return PagedKVCache(
+        k=put(cache.k, k_rows), v=put(cache.v, v_rows),
+        table=cache.table, length=length,
+    )
+
+
 def _masked_window_write(
     buf: jax.Array, rows: jax.Array, start: jax.Array, n: jax.Array
 ) -> jax.Array:
@@ -283,7 +508,8 @@ def forward_step(
 
     B, Tq = tokens.shape
     start = cache.length  # (B,) per-slot offsets
-    if n_tokens is not None and Tq > cache.capacity:
+    paged = isinstance(cache, (PagedKVCache, PagedQuantKVCache))
+    if not paged and n_tokens is not None and Tq > cache.capacity:
         # The masked write is a Tq-row window into the token axis; a window
         # wider than the buffer cannot be placed at any offset.
         raise ValueError(
@@ -326,9 +552,11 @@ def forward_step(
     positions = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)  # (B, Tq)
 
     x = jnp.take(params["embed"], tokens, axis=0)
-    quant = isinstance(cache, QuantKVCache)
+    quant = isinstance(cache, (QuantKVCache, PagedQuantKVCache))
     if obs.REGISTRY.enabled:
-        _STEP_DISPATCH.labels(cache="quant" if quant else "exact").inc()
+        kind = ("paged_quant" if quant else "paged") if paged \
+            else ("quant" if quant else "exact")
+        _STEP_DISPATCH.labels(cache=kind).inc()
 
     def body(x, layer_and_cache):
         if quant:
@@ -349,7 +577,22 @@ def forward_step(
         if quant:
             k_new = _quantize_rows(k_new, k_s)
             v_new = _quantize_rows(v_new, v_s)
-        if n_tokens is None:
+        if paged:
+            # Paged write: scatter through the block table — valid rows
+            # land in their slot's mapped blocks, padded rows drop. The
+            # contiguous path's window clamp machinery is unnecessary
+            # here (see _paged_pool_write).
+            n_valid = (
+                jnp.full((B,), Tq, jnp.int32) if n_tokens is None
+                else n_tokens
+            )
+            k_cache = _paged_pool_write(
+                k_cache, k_new, cache.table, start, n_valid
+            )
+            v_cache = _paged_pool_write(
+                v_cache, v_new, cache.table, start, n_valid
+            )
+        elif n_tokens is None:
             write = jax.vmap(
                 lambda buf, rows, s: lax.dynamic_update_slice_in_dim(
                     buf, rows, s, axis=1
@@ -382,6 +625,8 @@ def forward_step(
             model_axis=axes["model"],
             block_size=cfg.attn_block_size,
         )
+        if paged:
+            attn_kw["block_table"] = cache.table
         if quant:
             out, _ = decode_attention(
                 q, k_cache, v_cache, k_scale=k_s, v_scale=v_s,
@@ -403,7 +648,17 @@ def forward_step(
     x = rms_norm(x, params["ln_f"], cfg.norm_eps)
     logits = (x @ params["wout"]).astype(jnp.float32)
     grew = Tq if n_tokens is None else n_tokens
-    if quant:
+    if paged and quant:
+        new_cache: Union[KVCache, QuantKVCache, PagedKVCache,
+                         PagedQuantKVCache] = PagedQuantKVCache(
+            k=new_k, v=new_v, k_scale=cache.k_scale, v_scale=cache.v_scale,
+            table=cache.table, length=start + grew,
+        )
+    elif paged:
+        new_cache = PagedKVCache(
+            k=new_k, v=new_v, table=cache.table, length=start + grew
+        )
+    elif quant:
         new_cache = QuantKVCache(
             k=new_k, v=new_v, k_scale=cache.k_scale, v_scale=cache.v_scale,
             length=start + grew,
@@ -606,6 +861,7 @@ def decode_attention(
     num_splits: Optional[int] = None,
     block_size: Optional[int] = None,
     quant_kernel: str = "q8q",
+    block_table: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Op-level decode entry: split-KV on one device, tree merge on a mesh.
 
@@ -622,10 +878,38 @@ def decode_attention(
     of the int8 roofline at 64k ctx) at ~1/254 extra relative logit error —
     and ``"q8"`` keeps the bf16-cast kernel. ``impl`` and ``num_splits``
     apply to the exact path only (the q8 kernels are split-KV internally).
+    With ``block_table`` the call is **paged**: ``k``/``v`` are
+    ``(N, Hkv, block, D)`` pools and each batch row reads KV through its
+    ``(B, NB)`` table row (see :class:`PagedKVCache`); the pool is
+    replicated under a mesh, so the tree merge never applies.
     """
     quant = k_scale is not None
     if quant and v_scale is None or (not quant and v_scale is not None):
         raise ValueError("pass both k_scale and v_scale, or neither")
+    if block_table is not None:
+        # Paged KV: k/v are (N, Hkv, block, D) pools and the table maps
+        # each slot's logical blocks to pool rows. The pool is REPLICATED
+        # under a mesh (blocks land at arbitrary token offsets, so no
+        # static sharding of the block axis aligns with a seq shard), so
+        # the tree merge never applies — the flash/Pallas paths serve
+        # every topology.
+        if q_position is None:
+            raise ValueError("paged decode needs an explicit q_position")
+        if quant:
+            from tree_attention_tpu.ops.pallas_decode import (
+                resolve_q8_kernel,
+            )
+
+            kernel_fn = resolve_q8_kernel(quant_kernel)
+            return kernel_fn(
+                q, k, v, k_scale, v_scale, causal=True,
+                q_offset=q_position, block_size=block_size,
+                block_table=block_table,
+            )
+        return flash_decode(
+            q, k, v, q_position=q_position, num_splits=num_splits,
+            block_size=block_size, block_table=block_table,
+        )
     if q_position is None:
         q_position = k.shape[2] - q.shape[2]
     ax = prune_axes(

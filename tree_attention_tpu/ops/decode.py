@@ -79,6 +79,30 @@ def default_num_splits(kv_len: int, block_size: int) -> int:
     return max(1, min(cap, kv_len // max(block_size, 1)))
 
 
+def gather_paged_kv(
+    k: jax.Array, v: jax.Array, block_table: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Materialise the logical ``(B, Hkv, NB·block, D)`` view of a paged
+    pool: row ``b``'s logical block ``j`` is pool row ``block_table[b, j]``.
+
+    The eager reference of the block-table kernels — the Pallas paged
+    path streams exactly these rows in exactly this order through its
+    index maps, so "gather then run the contiguous path" and "run the
+    paged kernel" are bit-identical by construction (the oracle the
+    randomized block-table tests pin). Out-of-range entries clamp (the
+    engine keeps unmapped entries at 0; clamped garbage is causally
+    masked either way)."""
+
+    def g(pool: jax.Array) -> jax.Array:
+        B, NB = block_table.shape
+        N, Hkv, blk, D = pool.shape
+        idx = jnp.clip(block_table, 0, N - 1)
+        rows = jnp.moveaxis(pool[idx], 1, 2)  # (B, Hkv, NB, blk, D)
+        return rows.reshape(B, Hkv, NB * blk, D)
+
+    return g(k), g(v)
+
+
 def flash_decode(
     q: jax.Array,
     k: jax.Array,
@@ -88,6 +112,7 @@ def flash_decode(
     scale: Optional[float] = None,
     num_splits: Optional[int] = None,
     block_size: Optional[int] = None,
+    block_table: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Causal decode attention of a few new queries against a long KV buffer.
 
@@ -118,10 +143,28 @@ def flash_decode(
 
     Returns:
       ``(out, lse)``: ``(B, Hq, Tq, D)`` in q's dtype, ``(B, Hq, Tq)`` float32.
+
+    With ``block_table`` the buffer is **paged** (``k``/``v`` are
+    ``(N, Hkv, block, D)`` pools, see
+    :class:`~tree_attention_tpu.models.decode.PagedKVCache`): on the TPU
+    decode-kernel path the table rides scalar prefetch into the Pallas
+    kernel (no gather); everywhere else — the chunked-vmap CPU path and
+    prefill-sized Tq on the Q-tiled kernel — the logical view is
+    gathered once via :func:`gather_paged_kv` and the contiguous path
+    runs unchanged, which keeps eager and Pallas bit-exact.
     """
     B, Hq, Tq, D = q.shape
-    Tk = k.shape[2]
+    Tk = (
+        block_table.shape[1] * k.shape[2] if block_table is not None
+        else k.shape[2]
+    )
     if q_position is None:
+        if block_table is not None:
+            # Defaulting to Tk - Tq would place the queries at the END of
+            # the LOGICAL capacity, causally exposing every table entry —
+            # including unwritten ones still pointing at block 0 (some
+            # other slot's data). Paged callers know their true lengths.
+            raise ValueError("paged decode needs an explicit q_position")
         q_position = Tk - Tq
     # Ragged batch: one q_position per batch row (cache slot).
     ragged = getattr(q_position, "ndim", 0) == 1
@@ -142,6 +185,23 @@ def flash_decode(
         )
 
         impl = tpu_kernel_for(Tq)
+        if block_table is not None:
+            if impl == "pallas_decode":
+                from tree_attention_tpu.ops.pallas_decode import (
+                    attention_pallas_decode,
+                )
+
+                # The paged kernel: table-driven DMA, no gather copy.
+                _account_dispatch("paged_decode", Tk)
+                return attention_pallas_decode(
+                    q, k, v, causal=True, scale=scale,
+                    q_offset=q_position, kv_offset=0,
+                    block_table=block_table,
+                )
+            # Prefill-sized Tq rides the Q-tiled kernel, which has no
+            # table path — one gather materialises the logical view
+            # (amortised over Tq rows of prefill compute).
+            k, v = gather_paged_kv(k, v, block_table)
         bk = default_block_size(impl, Tk) if block_size is None else block_size
         # Static int offsets specialise the kernel (grid-level causal cull),
         # which is right for the fixed full-buffer default but would
@@ -175,6 +235,11 @@ def flash_decode(
             q, k, v, causal=True, scale=scale,
             q_offset=q_position, kv_offset=0, block_size=bk,
         )
+
+    if block_table is not None:
+        # Eager reference: one gather, then the contiguous chunked path —
+        # bit-exact with the paged kernel (see gather_paged_kv).
+        k, v = gather_paged_kv(k, v, block_table)
 
     block_size = 512 if block_size is None else block_size
     S = num_splits if num_splits is not None else default_num_splits(Tk, block_size)

@@ -290,6 +290,227 @@ def _flash_decode_q8q_kernel(
         _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
+def _flash_decode_paged_kernel(
+    offs_ref,  # SMEM (2, B) scalar-prefetch: per-batch [q_offset|kv_offset]
+    tbl_ref,   # SMEM (B, NB) scalar-prefetch block table — read by the
+               # K/V index maps, not the body: grid step si streams pool
+               # block table[b, si] (PagedAttention, arXiv:2309.06180)
+    q_ref,     # VMEM (1, bq, D) — packed (group × Tq) queries of one KV head
+    k_ref,     # VMEM (1, 1, block, D) — pool block tbl[b, si], head h
+    v_ref,     # VMEM (1, 1, block, D)
+    out_ref,   # VMEM (1, bq, D)
+    lse_ref,   # VMEM (1, bq, LANES)
+    m_scr,     # VMEM (bq, LANES) f32
+    l_scr,     # VMEM (bq, LANES) f32
+    acc_scr,   # VMEM (bq, D) f32
+    *,
+    scale: float,
+    causal: bool,
+    tq: int,
+    block_q: int,
+    block_k: int,
+    n_kv_heads: int,
+):
+    """Block-table variant of :func:`_flash_decode_kernel`: the split-KV
+    grid dimension walks each slot's LOGICAL blocks and the BlockSpec
+    index maps dereference the scalar-prefetched table, so fragmented /
+    non-monotone physical layouts stream exactly like a contiguous
+    buffer. The logical capacity ``NB·block`` is block-divisible by
+    construction, so the ragged-tail mask is statically off; the causal
+    mask against each slot's own ``q_offset`` hides every unwritten (or
+    garbage-mapped) position, and the per-slot liveness cull skips whole
+    blocks past the slot's length — a short slot reads only its own few
+    blocks of the pool."""
+    del tbl_ref  # consumed by the index maps
+    qi = pl.program_id(1)
+    si = pl.program_id(2)
+    n_s = pl.num_programs(2)
+    tk = n_s * block_k  # logical capacity; block-divisible by construction
+
+    b = pl.program_id(0) // n_kv_heads
+    q_offset = offs_ref[0, b]
+    kv_offset = offs_ref[1, b]
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    bq, bk = block_q, block_k
+
+    live = si * bk < tk
+    if causal:
+        live &= (kv_offset + si * bk) <= (q_offset + tq - 1)
+
+    @pl.when(live)
+    def _compute():
+        k_tile = k_ref[0, 0]
+        if k_tile.dtype == jnp.int8:
+            k_tile = k_tile.astype(jnp.bfloat16)
+        s = lax.dot_general(
+            q_ref[0],
+            k_tile,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision(q_ref.dtype, k_tile.dtype),
+        ) * scale
+
+        s = _decode_visibility_mask(
+            s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
+            q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+        )
+        _decode_softmax_fold(
+            s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
+        )
+
+    @pl.when(si == n_s - 1)
+    def _finalize():
+        _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _flash_decode_paged_q8q_kernel(
+    offs_ref,  # SMEM (2, B) scalar-prefetch
+    tbl_ref,   # SMEM (B, NB) scalar-prefetch block table
+    q_ref,     # VMEM (1, bq, D) int8 — per-row-quantized, scale-folded Q
+    qs_ref,    # VMEM (1, bq, LANES) f32 — per-row Q scales
+    k_ref,     # VMEM (1, 1, block, D) int8 — pool block tbl[b, si]
+    v_ref,     # VMEM (1, 1, block, D) int8
+    out_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    causal: bool,
+    tq: int,
+    block_q: int,
+    block_k: int,
+    n_kv_heads: int,
+):
+    """Block-table variant of :func:`_flash_decode_q8q_kernel` — same
+    int8-MXU score path, KV streamed through the scalar-prefetched
+    table (see :func:`_flash_decode_paged_kernel`)."""
+    del tbl_ref
+    qi = pl.program_id(1)
+    si = pl.program_id(2)
+    n_s = pl.num_programs(2)
+    tk = n_s * block_k
+
+    b = pl.program_id(0) // n_kv_heads
+    q_offset = offs_ref[0, b]
+    kv_offset = offs_ref[1, b]
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    bq, bk = block_q, block_k
+
+    live = si * bk < tk
+    if causal:
+        live &= (kv_offset + si * bk) <= (q_offset + tq - 1)
+
+    @pl.when(live)
+    def _compute():
+        s_i = lax.dot_general(
+            q_ref[0],
+            k_ref[0, 0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        s = s_i.astype(jnp.float32) * qs_ref[0][:, :1]
+
+        s = _decode_visibility_mask(
+            s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
+            q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+        )
+        _decode_softmax_fold(
+            s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
+        )
+
+    @pl.when(si == n_s - 1)
+    def _finalize():
+        _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _paged_q_map(bh, qi, si, offs_ref, tbl_ref):
+    """Q/out/lse index map of the paged decode grid (table unused)."""
+    del si, offs_ref, tbl_ref
+    return (bh, qi, 0)
+
+
+def _paged_kv_map(n_kv_heads: int):
+    """K/V index map: grid step ``si`` loads pool block
+    ``table[b, si]`` of head ``bh % Hkv`` — the block-table indirection
+    happens HERE, in the prefetch-driven DMA schedule, not in the body."""
+
+    def index_map(bh, qi, si, offs_ref, tbl_ref):
+        del qi, offs_ref
+        return (tbl_ref[bh // n_kv_heads, si], bh % n_kv_heads, 0, 0)
+
+    return index_map
+
+
+def _paged_decode_call(
+    kernel_body,
+    kernel_kwargs,
+    tensors,
+    in_specs,
+    *,
+    q_offset,
+    kv_offset,
+    block_table: jax.Array,
+    batch: int,
+    n_q: int,
+    bq: int,
+    d: int,
+    out_dtype,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shared ``pallas_call`` plumbing of the paged decode kernels.
+
+    Per-batch offsets AND the ``(B, NB)`` block table ride scalar
+    prefetch (``PrefetchScalarGridSpec``), the grid's sequential split-KV
+    dimension is the table width — one step per logical block — and the
+    K/V index maps dereference the table, so the DMA pipeline prefetches
+    physical blocks in logical order with no gather copy."""
+    NB = block_table.shape[1]
+    BH = tensors[0].shape[0]  # B * Hkv
+    offs = _offsets_smem(q_offset, kv_offset, batch)
+    tbl = jnp.asarray(block_table, jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(BH, n_q, NB),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), _paged_q_map),
+            pl.BlockSpec((1, bq, _LANES), _paged_q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(kernel_body, **kernel_kwargs),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, n_q * bq, d), out_dtype),
+            jax.ShapeDtypeStruct((BH, n_q * bq, _LANES), jnp.float32),
+        ],
+        # Only the split-KV (table) dim is sequential, as in the
+        # contiguous kernels.
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offs, tbl, *tensors)
+
+
 def resolve_q8_kernel(kernel: str):
     """The one home of the q8-kernel-name contract: ``"q8q"`` → the int8-MXU
     kernel (:func:`attention_pallas_decode_q8q`), ``"q8"`` → the bf16-cast
@@ -349,6 +570,7 @@ def attention_pallas_decode_q8(
     kv_offset=0,
     block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
+    block_table: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode over an int8-quantized KV buffer.
 
@@ -391,10 +613,12 @@ def attention_pallas_decode_q8(
     ).astype(jnp.bfloat16).reshape(B, Hq, Tq, D)
     # The base split-KV kernel runs the int8 K/V directly (in-kernel bf16
     # casts, exact for [-127, 127]; no dequant multiplies on the KV stream).
+    # A block_table passes straight through: the base kernel's paged path
+    # streams int8 pool blocks the same way.
     out, lse = attention_pallas_decode(
         qf, k_q, v_q, causal=causal, scale=scale,
         q_offset=q_offset, kv_offset=kv_offset, block_size=block_size,
-        interpret=interpret,
+        interpret=interpret, block_table=block_table,
     )
     # V's per-channel scale applies to the normalised accumulator.
     out = (
@@ -420,6 +644,7 @@ def attention_pallas_decode_q8q(
     kv_offset=0,
     block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
+    block_table: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """int8-MXU flash decode over an int8 KV buffer: Q quantized too.
 
@@ -435,7 +660,13 @@ def attention_pallas_decode_q8q(
     error; see measurements/r3/experiment_q8q.jsonl).
     """
     B, Hq, Tq, D = q.shape
-    Hkv, Tk = k_q.shape[1], k_q.shape[2]
+    Hkv = k_q.shape[1]
+    # Paged: k_q/v_q are (N, Hkv, block, D) pools; the logical context is
+    # the table width in blocks (see attention_pallas_decode).
+    Tk = (
+        block_table.shape[1] * k_q.shape[2] if block_table is not None
+        else k_q.shape[2]
+    )
     if k_q.dtype != jnp.int8 or v_q.dtype != jnp.int8:
         raise ValueError(
             f"k_q/v_q must be int8, got {k_q.dtype}/{v_q.dtype}"
@@ -479,6 +710,30 @@ def attention_pallas_decode_q8q(
         _pad_dim(qs, 2, bq).reshape(B * Hkv, n_q * bq, 1),
         (B * Hkv, n_q * bq, _LANES),
     )
+
+    if block_table is not None:
+        if obs.REGISTRY.enabled:
+            _KERNEL_BUILDS.labels(kernel="paged_q8q").inc()
+        blk = k_q.shape[2]
+        out, lse = _paged_decode_call(
+            _flash_decode_paged_q8q_kernel,
+            dict(causal=causal, tq=Tq, block_q=bq, block_k=blk,
+                 n_kv_heads=Hkv),
+            [qp, qsp, k_q, v_q],
+            [pl.BlockSpec((1, bq, D), _paged_q_map),
+             pl.BlockSpec((1, bq, _LANES), _paged_q_map),
+             pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
+             pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv))],
+            q_offset=q_offset, kv_offset=kv_offset,
+            block_table=block_table, batch=B, n_q=n_q, bq=bq, d=D,
+            out_dtype=jnp.bfloat16, interpret=interpret,
+        )
+        out = out[:, :r]
+        out = (
+            out.astype(jnp.float32).reshape(B, Hkv, r, D) * v_scale
+        ).reshape(B, Hq, Tq, D).astype(out_dtype)
+        lse = lse[:, :r, 0].reshape(B, Hq, Tq)
+        return out, lse
 
     if block_size is None:
         from tree_attention_tpu.ops.tuning import decode_block_k_q8
@@ -550,6 +805,7 @@ def attention_pallas_decode(
     kv_offset=0,
     block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
+    block_table: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode. Same ``(out, lse)`` contract as the other impls.
 
@@ -563,9 +819,24 @@ def attention_pallas_decode(
     the ragged-batch shape: each batch row is a cache slot with its own
     filled length, and the causal mask hides every row's unwritten future
     independently (offsets ride SMEM; the grid and tiles are unchanged).
+
+    With ``block_table`` (a ``(B, NB)`` int32 array) the call is **paged**:
+    ``k``/``v`` are ``(N, Hkv, block, D)`` pools and batch row ``b``'s
+    logical KV block ``j`` lives in pool row ``block_table[b, j]``. The
+    table rides scalar prefetch, the index maps dereference it, and the
+    split-KV tile IS the pool block (``block_size`` is ignored — one grid
+    step per logical block; on a real TPU keep the pool block >= the
+    dtype's min sublane tile, 8/16/32 for f32/bf16/int8). Every entry
+    must be a valid pool index; entries past a slot's length are masked
+    but still dereferenced (the engine keeps them at 0). Bit-exact with
+    gathering ``pool[table]`` into a contiguous buffer and calling the
+    unpaged kernel — the tiles stream identical rows in identical order.
     """
     B, Hq, Tq, D = q.shape
-    Hkv, Tk = k.shape[1], k.shape[2]
+    if block_table is not None:
+        Hkv, Tk = k.shape[1], block_table.shape[1] * k.shape[2]
+    else:
+        Hkv, Tk = k.shape[1], k.shape[2]
     if Hq % Hkv:
         raise ValueError(
             f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
@@ -596,6 +867,27 @@ def attention_pallas_decode(
     bq = min(-(-r // 8) * 8, 128)
     qp = _pad_dim(q.reshape(B, Hkv, r, D), 2, bq).reshape(B * Hkv, -1, D)
     n_q = qp.shape[1] // bq
+
+    if block_table is not None:
+        if obs.REGISTRY.enabled:
+            _KERNEL_BUILDS.labels(
+                kernel="paged_q8" if k.dtype == jnp.int8 else "paged"
+            ).inc()
+        out, lse = _paged_decode_call(
+            _flash_decode_paged_kernel,
+            dict(scale=s, causal=causal, tq=Tq, block_q=bq,
+                 block_k=k.shape[2], n_kv_heads=Hkv),
+            [qp, k, v],
+            [pl.BlockSpec((1, bq, D), _paged_q_map),
+             pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv)),
+             pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv))],
+            q_offset=q_offset, kv_offset=kv_offset,
+            block_table=block_table, batch=B, n_q=n_q, bq=bq, d=D,
+            out_dtype=q.dtype, interpret=interpret,
+        )
+        out = out[:, :r].reshape(B, Hq, Tq, D).astype(out_dtype)
+        lse = lse[:, :r, 0].reshape(B, Hq, Tq)
+        return out, lse
 
     if block_size is None:
         from tree_attention_tpu.ops.tuning import decode_block_k, decode_block_k_q8

@@ -16,4 +16,8 @@ from tree_attention_tpu.serving.engine import (  # noqa: F401
     SlotServer,
     synthetic_trace,
 )
-from tree_attention_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from tree_attention_tpu.serving.block_pool import BlockAllocator  # noqa: F401
+from tree_attention_tpu.serving.prefix_cache import (  # noqa: F401
+    PagedPrefixIndex,
+    PrefixCache,
+)

@@ -1,0 +1,184 @@
+"""Host-side allocator for the unified paged-KV block pool.
+
+The paged layout (:class:`~tree_attention_tpu.models.decode.PagedKVCache`,
+PagedAttention — arXiv:2309.06180) keeps ONE device pool of ``N`` blocks
+under every slot AND the radix prefix cache; this module is the host-side
+ledger that makes that sharing safe. Ownership is single-writer:
+
+- a **free** block belongs to the allocator's free list;
+- a **private** block belongs to exactly one slot (its decode/prefill
+  tail — rows only that slot writes);
+- a **cached** block belongs to exactly one radix-tree node
+  (:class:`~tree_attention_tpu.serving.prefix_cache.PagedPrefixIndex`),
+  published there by the slot that prefilled it — ownership moves, the
+  bytes do not. Any number of slots may *read* a cached block through
+  their tables; the node's pin count (``refs``) tracks them, and the
+  tree only evicts refcount-0 leaves.
+
+**Reservation-based admission** is what turns "over-subscribing the pool"
+into a clean scheduling decision instead of a shape error deep inside a
+jitted gather: an admission reserves its worst-case block count up front
+(``ceil((prompt + max_new) / block)`` minus the blocks a prefix hit
+already shares) against ``available() = free + evictable - reserved``,
+where *evictable* counts cached blocks in fully-unpinned subtrees. If the
+reservation does not fit, the request simply WAITS in the queue — the
+engine defers admission until retires/evictions free blocks — and a
+request that could never fit (needs more than the whole pool) fails
+``serve()``'s validation with a clear message. Every later
+:meth:`alloc` is backed by a prior reservation, so it cannot fail: when
+the free list is empty the evictor (the radix tree's LRU refcount-0-leaf
+eviction) is guaranteed to find a victim.
+
+Pure host integers — no device state — so the property tests can hammer
+hundreds of random admit/retire/hit/evict interleavings per second.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from tree_attention_tpu import obs
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("serving.blocks")
+
+_BLOCKS_USED = obs.gauge(
+    "serving_kv_blocks_used",
+    "unified KV pool blocks currently owned by a slot or the prefix tree",
+)
+_BLOCKS_FREE = obs.gauge(
+    "serving_kv_blocks_free",
+    "unified KV pool blocks on the free list",
+)
+
+# Block ownership states (the debug ledger's vocabulary).
+_FREE, _PRIVATE, _CACHED = 0, 1, 2
+
+
+class BlockAllocator:
+    """Free list + reservation accounting over ``blocks`` pool blocks.
+
+    The radix tree registers itself via :meth:`set_evictor`; without one
+    (prefix cache off) *evictable* is always 0 and the allocator is a
+    plain reserve-then-take free list.
+    """
+
+    def __init__(self, blocks: int):
+        if blocks < 1:
+            raise ValueError(f"block pool needs >= 1 block, got {blocks}")
+        self.blocks = blocks
+        # Pop from the end -> ascending ids early on (cosmetic, and it
+        # makes allocator traces readable).
+        self._free: List[int] = list(range(blocks - 1, -1, -1))
+        self._state = [_FREE] * blocks  # the double-free/leak ledger
+        self.reserved = 0
+        # Availability generation: bumped whenever availability can have
+        # GROWN (frees, unreserves; the engine also bumps on retire,
+        # whose pin releases grow evictability without touching the free
+        # list). A deferred admission latches the generation it failed
+        # at and skips the O(prompt) re-match + O(tree) evictability
+        # recount until the counter moves — pool state can't have
+        # improved in between.
+        self.gen = 0
+        self._evict_one: Optional[Callable[[], bool]] = None
+        self._evictable: Optional[Callable[[], int]] = None
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return self.blocks - len(self._free)
+
+    def evictable(self) -> int:
+        return self._evictable() if self._evictable is not None else 0
+
+    def available(self) -> int:
+        """Blocks an admission may still reserve: free + evictable-now,
+        minus what earlier admissions already promised themselves."""
+        return len(self._free) + self.evictable() - self.reserved
+
+    def publish_gauges(self) -> None:
+        if obs.REGISTRY.enabled:
+            _BLOCKS_USED.set(self.used)
+            _BLOCKS_FREE.set(len(self._free))
+
+    # -- the evictor hook (the radix tree) --------------------------------
+
+    def set_evictor(
+        self, evict_one: Callable[[], bool], evictable: Callable[[], int]
+    ) -> None:
+        """``evict_one()`` must free one refcount-0 cached leaf into this
+        allocator (returning False only when none exists); ``evictable()``
+        counts blocks reachable that way."""
+        self._evict_one = evict_one
+        self._evictable = evictable
+
+    # -- reservations -----------------------------------------------------
+
+    def reserve(self, n: int) -> bool:
+        """Promise ``n`` future :meth:`alloc` calls; False if the pool
+        cannot honor them (the engine defers the admission)."""
+        if n < 0:
+            raise ValueError(f"cannot reserve {n} blocks")
+        if n > self.available():
+            return False
+        self.reserved += n
+        return True
+
+    def unreserve(self, n: int) -> None:
+        """Return unused reservations (early EOS, retire)."""
+        self.reserved -= n
+        self.gen += 1
+        assert self.reserved >= 0, "block reservation underflow"
+
+    # -- allocation / ownership transitions -------------------------------
+
+    def alloc(self) -> int:
+        """One private block, consuming one reservation. Never fails:
+        reservations are only granted against free + evictable blocks,
+        and pins (which shrink evictability) are themselves reserved."""
+        assert self.reserved > 0, "alloc without a backing reservation"
+        self.reserved -= 1
+        if not self._free:
+            # Load-bearing call — NOT inside the assert (python -O strips
+            # assert statements, and the eviction must still run).
+            evicted = (self._evict_one is not None and self._evict_one())
+            if not evicted:
+                raise AssertionError(
+                    "allocator invariant broken: a backed reservation "
+                    "found neither a free block nor an evictable leaf"
+                )
+        bid = self._free.pop()
+        assert self._state[bid] == _FREE, f"block {bid} double-allocated"
+        self._state[bid] = _PRIVATE
+        return bid
+
+    def publish(self, bid: int) -> None:
+        """Ownership transfer private slot -> radix node (zero bytes
+        moved — the whole point of the paged layout)."""
+        assert self._state[bid] == _PRIVATE, (
+            f"block {bid} published while not privately owned"
+        )
+        self._state[bid] = _CACHED
+
+    def free_private(self, bid: int) -> None:
+        """A retiring slot returns a block it still owns."""
+        assert self._state[bid] == _PRIVATE, (
+            f"block {bid} freed while not privately owned"
+        )
+        self._state[bid] = _FREE
+        self._free.append(bid)
+        self.gen += 1
+
+    def free_cached(self, bid: int) -> None:
+        """The radix tree evicts a refcount-0 leaf's block."""
+        assert self._state[bid] == _CACHED, (
+            f"block {bid} evicted while not tree-owned"
+        )
+        self._state[bid] = _FREE
+        self._free.append(bid)
+        self.gen += 1

@@ -57,9 +57,29 @@ Variants:
   later hit re-quantizes under its own frozen scales — the
   quantize-after-prefill rule survives bit-for-bit).
 
-Works on one device and on a sequence-sharded mesh (the cache is
-seq-sharded; per-slot offsets and chunk windows ride the tree merge
-unchanged).
+- ``kv_layout="paged"`` (ISSUE 6, the default) replaces the per-slot
+  contiguous cache with ONE ref-counted block pool under every slot AND
+  the prefix cache (vLLM's PagedAttention, arXiv:2309.06180): each slot
+  is a host-side block table into the pool
+  (:class:`~tree_attention_tpu.models.decode.PagedKVCache`), physical
+  blocks are allocated on demand by a reservation-based host allocator
+  (:mod:`~tree_attention_tpu.serving.block_pool`), and prefix reuse is
+  **reference-in-place** — a radix hit bumps pins and writes pool ids
+  into the slot's table (zero KV bytes moved, vs. the contiguous
+  layout's pool→slot gather), while prefill completion publishes by
+  HANDING blocks over to the tree. Admissions that cannot reserve their
+  worst-case block count simply wait in the queue, so the pool can be
+  sized well under ``slots × cache_len`` and the slot count can exceed
+  what a contiguous layout could hold at equal bytes. int8 serving pages
+  the slot cache too, but keeps the exact-dtype sidecar pool for prefix
+  hits (per-slot frozen scales make int8 blocks unshareable — the
+  quantize-after-prefill contract). ``kv_layout="contiguous"`` keeps the
+  PR-5 layout.
+
+Works on one device and on a sequence-sharded mesh (the contiguous cache
+is seq-sharded and rides the tree merge; the paged pool is replicated —
+block offsets cannot stay aligned with a sequence shard — and rides the
+flash/Pallas paths).
 """
 
 from __future__ import annotations
@@ -81,12 +101,17 @@ from tree_attention_tpu.obs.metrics import percentile
 from tree_attention_tpu.obs.slo import SLOMonitor
 from tree_attention_tpu.models.decode import (
     KVCache,
+    PagedKVCache,
+    PagedQuantKVCache,
     QuantKVCache,
     _sample,
     forward_step,
     init_cache,
+    init_paged_cache,
+    paged_insert_slot,
     quantize_cache,
 )
+from tree_attention_tpu.serving.block_pool import BlockAllocator
 from tree_attention_tpu.models.transformer import Params, TransformerConfig
 from tree_attention_tpu.utils.logging import get_logger
 
@@ -173,6 +198,9 @@ class ServeReport:
     # Prefix-reuse accounting for THIS run (diff of the pool's lifetime
     # stats over the serve() call); empty when the cache is off.
     prefix: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Paged-pool accounting (block occupancy at run end + peak); empty
+    # under the contiguous layout.
+    kv: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -209,6 +237,7 @@ class ServeReport:
             **{k: round(v, 5) for k, v in self.latency_percentiles().items()},
             **({"slo": self.slo} if self.slo else {}),
             **({"prefix": self.prefix} if self.prefix else {}),
+            **({"kv": self.kv} if self.kv else {}),
         }
 
 
@@ -325,11 +354,35 @@ class SlotServer:
         gauges only publish while the metrics registry records.
       prefix_cache: enable shared-prompt KV reuse — admissions match
         their prompt against a radix tree of published prefixes and skip
-        prefill for the matched blocks (one pool gather instead).
+        prefill for the matched blocks (reference-in-place under the
+        paged layout — zero KV bytes moved; one pool gather under the
+        contiguous layout and under int8, whose per-slot frozen scales
+        need the exact sidecar pool).
       prefix_block: tokens per prefix pool block (power of two; the
-        match/publish granularity).
-      prefix_pool_blocks: pool capacity in blocks (LRU-evicted at
-        refcount 0).
+        match/publish granularity). Under the paged layout this is also
+        the default page size (``kv_block``) so matching stays
+        block-aligned with the tables.
+      prefix_pool_blocks: how many blocks the prefix tree may RETAIN
+        (LRU-evicted at refcount 0). Under the contiguous layout this
+        sizes the separate device pool (default 64); under the paged
+        layout it is only a retention cap on the shared pool (default
+        None = bounded by the pool itself). The CLI's
+        ``--prefix-pool-blocks`` is deprecated in favor of the unified
+        ``--kv-blocks`` budget.
+      kv_layout: ``"paged"`` (default — one block pool under every slot,
+        block-table decode, copy-free prefix hits) or ``"contiguous"``
+        (the PR-5 layout: per-slot contiguous regions + gather hits).
+      kv_block: tokens per pool block (power of two). Default: follows
+        ``prefix_block`` when the prefix cache is on (match granularity
+        == page size), else 64. On a real TPU keep it >= the dtype's
+        minimum sublane tile (8 f32 / 16 bf16 / 32 int8).
+      kv_blocks: TOTAL pool capacity in blocks — the one KV memory
+        budget (slots and prefix cache share it). Default:
+        ``slots × ceil(cache_len / kv_block)``, the contiguous layout's
+        capacity at equal bytes. Size it smaller to over-subscribe:
+        admissions whose worst case cannot be reserved wait in the
+        queue, and a request that could never fit fails validation with
+        a clear message.
     """
 
     def __init__(
@@ -352,13 +405,21 @@ class SlotServer:
         slo_window: int = 1024,
         prefix_cache: bool = False,
         prefix_block: int = 64,
-        prefix_pool_blocks: int = 64,
+        prefix_pool_blocks: Optional[int] = None,
+        kv_layout: str = "paged",
+        kv_block: Optional[int] = None,
+        kv_blocks: Optional[int] = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if admission not in ("chunked", "whole"):
             raise ValueError(
                 f"admission must be 'chunked' or 'whole', got {admission!r}"
+            )
+        if kv_layout not in ("paged", "contiguous"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'contiguous', "
+                f"got {kv_layout!r}"
             )
         if prefill_chunk < 1:
             raise ValueError(
@@ -400,11 +461,50 @@ class SlotServer:
             from tree_attention_tpu.parallel.mesh import AXIS_SEQ
 
             self._seq_shards = max(mesh.shape.get(AXIS_SEQ, 1), 1)
-        cache: Union[KVCache, QuantKVCache] = init_cache(
-            cfg, slots, cache_len, **kw
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        # Bytes a contiguous-layout hit gathers per matched token — the
+        # cost a paged hit deletes (the bytes_moved span arg).
+        self._kv_token_bytes = (
+            2 * cfg.n_layers * cfg.n_kv_heads * cfg.d_head
+            * jnp.dtype(cfg.dtype).itemsize
         )
-        if quantize:
-            cache = quantize_cache(cache)  # empty prefix -> fallback scales
+        if self._paged:
+            if kv_block is None:
+                # Matching granularity == page size keeps radix hits
+                # table-aligned (a matched prefix IS whole table entries).
+                kv_block = prefix_block if prefix_cache else 64
+            elif prefix_cache and kv_block != prefix_block:
+                # Honoring only one of them silently would make the
+                # recorded config contradict the running granularity.
+                raise ValueError(
+                    f"paged layout: prefix_block ({prefix_block}) must "
+                    f"equal kv_block ({kv_block}) — radix matching "
+                    f"happens at page granularity (pass one of them, or "
+                    f"equal values)"
+                )
+            self.kv_block = kv_block
+            self._npb = -(-cache_len // kv_block)  # table width (blocks)
+            self.kv_blocks = (
+                slots * self._npb if kv_blocks is None else kv_blocks
+            )
+            self._pool = BlockAllocator(self.kv_blocks)
+            self._host_table = np.zeros((slots, self._npb), np.int32)
+            self._table_dirty = False  # device table starts all-zero too
+            self._slot_nblocks = [0] * slots
+            self._slot_private: List[set] = [set() for _ in range(slots)]
+            self._slot_reserve = [0] * slots
+            self._peak_blocks_used = 0
+            self._defer_gen = -1  # see the admit loop's generation latch
+            cache: Union[KVCache, QuantKVCache, PagedKVCache,
+                         PagedQuantKVCache] = init_paged_cache(
+                cfg, slots, cache_len, self.kv_blocks,
+                block=kv_block, quantize=quantize, **kw
+            )
+        else:
+            cache = init_cache(cfg, slots, cache_len, **kw)
+            if quantize:
+                cache = quantize_cache(cache)  # empty -> fallback scales
         self.cache = cache
         self.tok = jnp.zeros((slots,), jnp.int32)
 
@@ -419,6 +519,12 @@ class SlotServer:
         self._slot_state: List[str] = ["free"] * slots
         self._slot_ttft: List[float] = [0.0] * slots
         self._prefill_pos: List[int] = [0] * slots
+        # Where each slot's prefill STARTED (0 cold, the matched length on
+        # a prefix hit) — the first consumed chunk resets the slot's
+        # device length to exactly this value (a no-op where a contiguous
+        # gather already set it; load-bearing under the paged layout,
+        # where a hit is pure host bookkeeping).
+        self._prefill_start: List[int] = [0] * slots
         self._prompt_np: List[Optional[np.ndarray]] = [None] * slots
         self._prefill_fifo: List[int] = []  # prefilling slots, admit order
         self._last_tok_t: List[float] = [0.0] * slots
@@ -437,16 +543,19 @@ class SlotServer:
             ttft_slo=slo_ttft, tbt_slo=slo_tbt, window=slo_window
         )
 
-        # Prefix reuse (ISSUE 5): the radix tree + device block pool, plus
-        # the per-slot ref ledger — nodes a slot matched or published stay
-        # pinned (unevictable) until that slot retires.
-        self._prefix: Optional["PrefixCache"] = None
+        # Prefix reuse (ISSUE 5/6): the radix tree, plus the per-slot ref
+        # ledger — nodes a slot matched or published stay pinned
+        # (unevictable) until that slot retires. Paged exact serving uses
+        # the in-place index over the unified pool (zero-copy hits);
+        # contiguous — and int8, whose per-slot frozen scales make pool
+        # blocks unshareable — keep the PR-5 gather pool.
+        self._prefix: Optional[Any] = None
+        self._paged_prefix = False
         self._slot_nodes: List[List[Any]] = [[] for _ in range(slots)]
         self._tick_prefix_hits = 0
         self._tick_prefix_reused = 0
+        self._hit_bytes_moved = 0
         if prefix_cache:
-            from tree_attention_tpu.serving.prefix_cache import PrefixCache
-
             if prefix_block > cache_len:
                 # Checked before the pool allocates: a block wider than a
                 # slot could never be copied anywhere.
@@ -454,10 +563,27 @@ class SlotServer:
                     f"prefix_block {prefix_block} exceeds cache_len "
                     f"{cache_len}"
                 )
-            self._prefix = PrefixCache(
-                cfg, block=prefix_block, blocks=prefix_pool_blocks,
-                mesh=mesh,
-            )
+            if self._paged and not quantize:
+                from tree_attention_tpu.serving.prefix_cache import (
+                    PagedPrefixIndex,
+                )
+
+                self._prefix = PagedPrefixIndex(
+                    block=self.kv_block, alloc=self._pool,
+                    max_cached=prefix_pool_blocks,
+                )
+                self._paged_prefix = True
+            else:
+                from tree_attention_tpu.serving.prefix_cache import (
+                    PrefixCache,
+                )
+
+                self._prefix = PrefixCache(
+                    cfg, block=prefix_block,
+                    blocks=(64 if prefix_pool_blocks is None
+                            else prefix_pool_blocks),
+                    mesh=mesh,
+                )
 
         # Reusable host scratch for the legacy whole-prompt admission's
         # padded prompt matrix, one per bucket — the chunked path never
@@ -491,7 +617,7 @@ class SlotServer:
         # call's outputs, so the old buffers are donated — each call
         # updates the (L,S,Hkv,Tmax,D) cache in place instead of copying
         # it (backends without donation just copy).
-        self._mixed = jax.jit(self._mixed_fn, donate_argnums=(5,))
+        self._mixed = jax.jit(self._mixed_fn, donate_argnums=(6,))
         self._prefill = jax.jit(self._prefill_fn)
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0, 1))
         if self._needs_staging:
@@ -506,7 +632,7 @@ class SlotServer:
             # built single-slot chunks through the SAME mixed-step family
             # (every other slot rides inert with its parked token intact).
             self._whole_suffix = jax.jit(
-                self._whole_suffix_fn, donate_argnums=(5,)
+                self._whole_suffix_fn, donate_argnums=(7,)
             )
 
     # -- compiled pieces --------------------------------------------------
@@ -526,21 +652,25 @@ class SlotServer:
             b *= 2
         return min(b, self.prefill_chunk)
 
-    def _mixed_fn(self, params, tokens, n_tok, reset, emit, cache, key):
+    def _mixed_fn(self, params, tokens, n_tok, reset, reset_val, emit,
+                  cache, key):
         """THE per-tick program: one mixed-Tq forward_step for every slot.
 
         ``tokens`` is ``(S, Tq)`` (Tq = 1 on pure-decode ticks, a chunk
         bucket otherwise); slot ``i`` consumes ``n_tok[i]`` rows — 1 for a
         live decode slot, a chunk for a prefilling slot, 0 for everything
-        else (inert: nothing written, length frozen). ``reset`` zeroes a
-        slot's length before the write (a slot starting its first chunk
-        reuses a retired slot's region). Each slot samples from its own
-        last valid row; ``emit`` keeps the sample (decode slots and
-        final-chunk slots) or holds the slot's row-0 token (everything
-        else — in particular a parked first token rides through
-        unchanged).
+        else (inert: nothing written, length frozen). ``reset`` sets a
+        slot's length to ``reset_val[i]`` before the write — 0 for a cold
+        first chunk (the slot reuses a retired slot's region), the
+        matched prefix length on a prefix hit (where a contiguous gather
+        already set the device length this is a no-op; under the paged
+        layout the hit was pure host bookkeeping and THIS is where the
+        device learns it). Each slot samples from its own last valid row;
+        ``emit`` keeps the sample (decode slots and final-chunk slots) or
+        holds the slot's row-0 token (everything else — in particular a
+        parked first token rides through unchanged).
         """
-        length = jnp.where(reset, 0, cache.length)
+        length = jnp.where(reset, reset_val, cache.length)
         cache = dataclasses.replace(cache, length=length)
         kw = dict(self._fs_kw)
         if self.quantize:
@@ -555,25 +685,29 @@ class SlotServer:
         nxt = jnp.where(emit, nxt, tokens[:, 0])
         return nxt, new_cache, key
 
-    def _whole_suffix_fn(self, params, rows, slot, n, last, cache,
-                         tok_vec, key):
+    def _whole_suffix_fn(self, params, rows, slot, n, last, first, start,
+                         cache, tok_vec, key):
         """One suffix chunk of a whole-admission prefix hit: slot ``slot``
         consumes ``n`` of the ``rows`` (a padded ``(Tq,)`` chunk of its
         prompt) while every other slot rides inert — their parked tokens
         pass through untouched because the token matrix is built from the
         DEVICE token vector (an ``await`` slot's first token only exists
-        there until the next batched fetch). The slot's length was set by
-        the hit gather, so no reset is ever needed. Emits the first
-        sampled token into the token vector on the final chunk."""
+        there until the next batched fetch). On the FIRST suffix chunk
+        the slot's length resets to ``start`` (= the matched prefix
+        length): a no-op where the contiguous hit gather already set it,
+        the one place the device learns the hit under the paged layout.
+        Emits the first sampled token into the token vector on the final
+        chunk."""
         S, tq = self.slots, rows.shape[0]
         tokens = jnp.zeros((S, tq), jnp.int32).at[:, 0].set(tok_vec)
         tokens = lax.dynamic_update_slice(tokens, rows[None, :], (slot, 0))
         one_hot = jnp.arange(S, dtype=jnp.int32) == slot
         n_vec = jnp.where(one_hot, n, 0).astype(jnp.int32)
         emit = one_hot & last
-        reset = jnp.zeros((S,), bool)
-        return self._mixed_fn(params, tokens, n_vec, reset, emit, cache,
-                              key)
+        reset = one_hot & first
+        reset_val = jnp.where(one_hot, start, 0).astype(jnp.int32)
+        return self._mixed_fn(params, tokens, n_vec, reset, reset_val,
+                              emit, cache, key)
 
     def _prefill_fn(self, params, prompt, plen, key):
         """Legacy whole-prompt admission: prefill one request into a fresh
@@ -614,11 +748,27 @@ class SlotServer:
         the batch cache (k/v rows, per-slot length, first token). The
         slot's rows beyond the bucket keep stale bytes from the previous
         occupant — every row >= the new length is masked future, and
-        decode overwrites them before they can become visible."""
+        decode overwrites them before they can become visible. Under the
+        paged layout the rows scatter through the slot's block table
+        (the engine mapped blocks covering ``[0, plen)`` first)."""
         if self.quantize:
             k_new, v_new, ks_new, vs_new, first = payload
         else:
             k_new, v_new, first = payload
+        if self._paged:
+            plen_i = jnp.asarray(plen, jnp.int32)
+            if self.quantize:
+                new_cache = paged_insert_slot(
+                    cache, slot, k_new, v_new, plen_i, ks_new, vs_new
+                )
+            else:
+                new_cache = paged_insert_slot(
+                    cache, slot, k_new, v_new, plen_i
+                )
+            tok_vec = lax.dynamic_update_index_in_dim(
+                tok_vec, first, slot, axis=0
+            )
+            return new_cache, tok_vec
         put = lambda buf, new: lax.dynamic_update_slice(
             buf, new.astype(buf.dtype), (0, slot, 0, 0, 0)
         )
@@ -639,11 +789,13 @@ class SlotServer:
         tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot, axis=0)
         return new_cache, tok_vec
 
-    def _stage_chunk_fn(self, params, tokens, n_tok, staging, reset):
+    def _stage_chunk_fn(self, params, tokens, n_tok, staging, reset,
+                        reset_val):
         """One mid-prompt chunk into the exact staging cache (quantized
         chunked admission). Logits are unused here, so XLA prunes the
-        output head."""
-        length = jnp.where(reset, 0, staging.length)
+        output head. ``reset_val`` mirrors the mixed step's: the first
+        chunk sets the staged length to the prefix-hit match (0 cold)."""
+        length = jnp.where(reset, reset_val, staging.length)
         staging = dataclasses.replace(staging, length=length)
         _, staging = forward_step(
             params, tokens, staging, self.cfg, n_tokens=n_tok,
@@ -652,14 +804,15 @@ class SlotServer:
         return staging
 
     def _stage_final_fn(self, params, tokens, n_tok, staging, cache,
-                        tok_vec, slot, plen, reset, key):
+                        tok_vec, slot, plen, reset, reset_val, key):
         """The final chunk: finish the staged exact prefill, sample the
         first token from the last valid row, mask the stale tail, quantize
         the staged prefix under its own frozen scales (the
         quantize-after-prefill contract), and insert slot rows + scales +
         length + first token into the batch cache — one dispatch, no host
-        sync (the token rides the per-tick fetch)."""
-        length = jnp.where(reset, 0, staging.length)
+        sync (the token rides the per-tick fetch). Under the paged layout
+        the insert scatters through the slot's block table."""
+        length = jnp.where(reset, reset_val, staging.length)
         staging = dataclasses.replace(staging, length=length)
         logits, staging = forward_step(
             params, tokens, staging, self.cfg, n_tokens=n_tok,
@@ -676,17 +829,23 @@ class SlotServer:
             v=jnp.where(valid, staging.v, 0),
             length=staging.length,
         ))
-        put = lambda buf, new: lax.dynamic_update_index_in_dim(
-            buf, new[:, 0], slot, axis=1
-        )
-        new_cache = QuantKVCache(
-            k=put(cache.k, qc.k), v=put(cache.v, qc.v),
-            k_scale=put(cache.k_scale, qc.k_scale),
-            v_scale=put(cache.v_scale, qc.v_scale),
-            length=lax.dynamic_update_index_in_dim(
-                cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
-            ),
-        )
+        if self._paged:
+            new_cache = paged_insert_slot(
+                cache, slot, qc.k, qc.v, jnp.asarray(plen, jnp.int32),
+                qc.k_scale, qc.v_scale,
+            )
+        else:
+            put = lambda buf, new: lax.dynamic_update_index_in_dim(
+                buf, new[:, 0], slot, axis=1
+            )
+            new_cache = QuantKVCache(
+                k=put(cache.k, qc.k), v=put(cache.v, qc.v),
+                k_scale=put(cache.k_scale, qc.k_scale),
+                v_scale=put(cache.v_scale, qc.v_scale),
+                length=lax.dynamic_update_index_in_dim(
+                    cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
+                ),
+            )
         tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot,
                                                   axis=0)
         return staging, new_cache, tok_vec
@@ -712,9 +871,79 @@ class SlotServer:
                 f"request {req.uid}: prompt {plen} + max_new "
                 f"{req.max_new_tokens} exceeds slot capacity {self.cache_len}"
             )
+        if self._paged:
+            # The clean over-subscription failure: a request whose worst
+            # case exceeds the WHOLE pool can never be admitted — reject
+            # it here, in English, instead of wedging the queue (a
+            # merely-scarce pool defers admission instead; see serve()).
+            need = -(-(plen + req.max_new_tokens) // self.kv_block)
+            if need > self.kv_blocks:
+                raise ValueError(
+                    f"request {req.uid}: worst case needs {need} KV "
+                    f"blocks (prompt {plen} + max_new "
+                    f"{req.max_new_tokens} at --kv-block {self.kv_block}) "
+                    f"but the --kv-blocks pool holds {self.kv_blocks}; "
+                    f"raise --kv-blocks or shrink the request"
+                )
+
+    # -- paged-pool bookkeeping -------------------------------------------
+
+    def _paged_reserve(self, req: Request) -> Optional[Tuple[int, List[Any],
+                                                             int]]:
+        """Match (pinning the path) + reserve the admission's worst-case
+        private blocks; ``None`` defers the admission — the request waits
+        in the queue until retires/evictions free blocks. Exact paged
+        serving subtracts the prefix hit's shared blocks from the
+        reservation (the sharing that lets slot-count exceed pool bytes);
+        int8 reserves the full span (its hits land in the exact staging
+        cache, not the slot's blocks)."""
+        total = -(-(len(req.prompt) + req.max_new_tokens) // self.kv_block)
+        matched, nodes = 0, []
+        if self._paged_prefix:
+            matched, nodes = self._prefix.match(
+                np.asarray(req.prompt, np.int32), record=False
+            )
+        needed = total - matched // self.kv_block
+        if not self._pool.reserve(needed):
+            if nodes:
+                self._prefix.release(nodes)
+            return None
+        if self._paged_prefix:
+            self._prefix.record_match(matched)
+        return matched, nodes, needed
+
+    def _ensure_blocks(self, slot: int, tokens_needed: int) -> None:
+        """Map physical blocks covering ``[0, tokens_needed)`` tokens of
+        ``slot`` — called before every dispatch that writes the slot.
+        Allocation is backed by the admission's reservation, so it cannot
+        fail; a full free list recycles LRU refcount-0 prefix leaves."""
+        if not self._paged:
+            return
+        need = -(-tokens_needed // self.kv_block)
+        while self._slot_nblocks[slot] < need:
+            assert self._slot_reserve[slot] > 0, (
+                f"slot {slot} outgrew its block reservation"
+            )
+            bid = self._pool.alloc()
+            self._slot_reserve[slot] -= 1
+            self._host_table[slot, self._slot_nblocks[slot]] = bid
+            self._slot_private[slot].add(bid)
+            self._slot_nblocks[slot] += 1
+            self._table_dirty = True
+
+    def _sync_table(self) -> None:
+        """Push the host block table to the device when it changed — the
+        ONE host→device transfer a table update costs (a few hundred
+        int32s; the contiguous layout's prefix hit moved the KV itself)."""
+        if self._paged and self._table_dirty:
+            self.cache = dataclasses.replace(
+                self.cache, table=jnp.asarray(self._host_table)
+            )
+            self._table_dirty = False
 
     def _admit(self, req: Request, slot: int, tick: int,
-               visible_at: float) -> float:
+               visible_at: float,
+               resv: Optional[Tuple[int, List[Any], int]] = None) -> float:
         # Queue wait ends the moment the scheduler takes the request —
         # BEFORE any prefill work runs (prefill, including a first-bucket
         # jit compile, is service time, not queueing).
@@ -728,7 +957,19 @@ class SlotServer:
         # Prefix reuse happens FIRST: the matched length decides how much
         # prompt is left to prefill (and rides the request span below).
         self._prompt_np[slot] = np.asarray(req.prompt, np.int32)
-        matched = self._prefix_admit(req, slot, tick)
+        if self._paged:
+            # The reservation was taken (and the radix path pinned) by
+            # _paged_reserve in the admit loop — here the slot takes
+            # ownership of both.
+            _, _, needed = resv
+            self._slot_reserve[slot] = needed
+            self._slot_private[slot] = set()
+            self._slot_nblocks[slot] = 0
+        if self._paged_prefix:
+            matched = self._paged_hit(req, slot, tick, resv)
+        else:
+            matched = self._prefix_admit(req, slot, tick)
+        self._prefill_start[slot] = matched
         # The request's life as ONE span (admit -> retire; rid in args so
         # a Perfetto query groups every event of one request), plus an
         # admitted instant on the timeline.
@@ -780,6 +1021,8 @@ class SlotServer:
             self.cache = self._prefix.copy_into(
                 self.cache, slot, nodes, matched
             )
+        moved = matched * self._kv_token_bytes  # the gather's device bytes
+        self._hit_bytes_moved += moved
         self._tick_prefix_hits += 1
         self._tick_prefix_reused += matched
         if obs.TRACER.active:
@@ -787,16 +1030,67 @@ class SlotServer:
                 "rid": req.uid, "slot": slot, "tick": tick,
                 "matched_tokens": matched,
                 "prompt_len": len(req.prompt),
+                "bytes_moved": moved,
+            })
+        return matched
+
+    def _paged_hit(self, req: Request, slot: int, tick: int,
+                   resv: Tuple[int, List[Any], int]) -> int:
+        """The reference-in-place hit (paged exact serving): write the
+        matched path's pool ids into the slot's table row and set the
+        prefill start — pure host bookkeeping, ZERO device KV bytes
+        moved (``bytes_moved=0`` on the instant is the measured claim,
+        not a slogan: the device sees nothing until the next dispatch
+        ships the updated int32 table)."""
+        matched, nodes, _ = resv
+        self._slot_nodes[slot] = nodes
+        if not matched:
+            return 0
+        for j, node in enumerate(nodes):
+            self._host_table[slot, j] = node.block_id
+        self._slot_nblocks[slot] = matched // self.kv_block
+        self._table_dirty = True
+        self._tick_prefix_hits += 1
+        self._tick_prefix_reused += matched
+        if obs.TRACER.active:
+            obs.instant("prefix_hit", cat="serving", args={
+                "rid": req.uid, "slot": slot, "tick": tick,
+                "matched_tokens": matched,
+                "prompt_len": len(req.prompt),
+                "bytes_moved": 0,
             })
         return matched
 
     def _publish_prefix(self, slot: int) -> None:
         """At final-chunk completion: put the prompt's full blocks into
-        the pool (one donated scatter for whatever the tree was missing)
-        and swap the slot's pinned path for the published one. Reads
-        exact rows — the batch cache slot, or the staging cache under
-        int8 (whose rows ARE the exact prefill, pre-quantization)."""
+        the pool and swap the slot's pinned path for the published one.
+
+        Paged exact serving publishes by ADOPTION — ownership of the
+        slot's private prompt blocks moves to the radix tree through the
+        allocator's ledger, the KV bytes stay exactly where the prefill
+        scattered them, and the slot keeps reading them through its
+        unchanged table (zero device work). The contiguous and int8
+        paths keep the PR-5 donated scatter — reading exact rows from
+        the batch cache slot, or from the staging cache under int8
+        (whose rows ARE the exact prefill, pre-quantization)."""
         if self._prefix is None:
+            return
+        if self._paged_prefix:
+            prompt = self._prompt_np[slot]
+            nb_full = len(prompt) // self.kv_block
+            private = self._slot_private[slot]
+            phys = {
+                j: int(self._host_table[slot, j]) for j in range(nb_full)
+                if int(self._host_table[slot, j]) in private
+            }
+            path, adopted = self._prefix.adopt(
+                prompt, phys, self._slot_nodes[slot]
+            )
+            for j in adopted:
+                private.discard(int(self._host_table[slot, j]))
+            # The admit-time pins carried over into ``path`` (plus the
+            # freshly created nodes); retire releases them all at once.
+            self._slot_nodes[slot] = path
             return
         path, new_ids, start = self._prefix.insert(self._prompt_np[slot])
         if new_ids:
@@ -843,7 +1137,8 @@ class SlotServer:
             while pos < plen:
                 n = min(self.prefill_chunk, plen - pos)
                 last = pos + n == plen
-                rows, _ = self._consume_chunk(slot, n, last)
+                self._ensure_blocks(slot, pos + n)
+                rows, first = self._consume_chunk(slot, n, last)
                 tq = self._chunk_bucket(n)
                 # Same no-per-admit-alloc discipline as the cold path's
                 # scratch below, keyed by (1, tq) row shape.
@@ -854,14 +1149,17 @@ class SlotServer:
                 else:
                     pad[0, n:] = 0
                 pad[0, :n] = rows
+                self._sync_table()
                 self.tok, self.cache, self._key = self._whole_suffix(
                     self.params, jnp.asarray(pad[0]), jnp.int32(slot),
-                    jnp.int32(n), jnp.asarray(last), self.cache, self.tok,
-                    self._key,
+                    jnp.int32(n), jnp.asarray(last), jnp.asarray(first),
+                    jnp.int32(self._prefill_start[slot]), self.cache,
+                    self.tok, self._key,
                 )
                 pos += n
             self._publish_prefix(slot)
             return
+        self._ensure_blocks(slot, plen)
         bucket = _bucket(plen, self.cache_len, multiple=self._seq_shards)
         # Reusable per-bucket scratch: zero the tail a longer previous
         # occupant may have left, then lay the prompt in — jnp.asarray
@@ -876,6 +1174,7 @@ class SlotServer:
         self._key, sub = jax.random.split(self._key)
         payload = self._prefill(self.params, jnp.asarray(padded),
                                 jnp.int32(plen), sub)
+        self._sync_table()
         self.cache, self.tok = self._insert(
             self.cache, self.tok, jnp.int32(slot), payload, plen
         )
@@ -906,8 +1205,10 @@ class SlotServer:
         fused and staged paths share: slice the prompt rows, advance the
         slot's running position, and on the final chunk move the slot to
         ``await`` (its first sampled token lands in this tick's batched
-        fetch). Returns the token rows and whether this chunk starts the
-        prompt (the slot's length must reset before the write)."""
+        fetch). Returns the token rows and whether this chunk STARTS the
+        slot's prefill (pos == the admission's start offset — 0 cold, the
+        matched length on a hit; the step resets the slot's length to
+        that offset before the write)."""
         pos = self._prefill_pos[slot]
         rows = self._prompt_np[slot][pos:pos + n]
         self._prefill_pos[slot] = pos + n
@@ -928,7 +1229,7 @@ class SlotServer:
                          f"{-(-plen // self.prefill_chunk)}",
                 "n": int(n), "pos": pos + n, "prompt_len": plen,
             })
-        return rows, pos == 0
+        return rows, pos == self._prefill_start[slot]
 
     def _run_staged_chunk(self, slot: int, n: int, last: bool) -> None:
         """Quantized chunked admission: advance one slot's staged exact
@@ -939,12 +1240,17 @@ class SlotServer:
         mat[0, :n] = rows
         n_vec = jnp.asarray([n], jnp.int32)
         reset = jnp.asarray([first])
+        reset_val = jnp.asarray([self._prefill_start[slot]], jnp.int32)
         if last:
+            # The quantized insert scatters the whole staged prompt into
+            # the slot — its blocks must all be mapped first.
+            self._ensure_blocks(slot, plen)
+            self._sync_table()
             self._key, sub = jax.random.split(self._key)
             self._staging, self.cache, self.tok = self._stage_final(
                 self.params, jnp.asarray(mat), n_vec, self._staging,
                 self.cache, self.tok, jnp.int32(slot), jnp.int32(plen),
-                reset, sub,
+                reset, reset_val, sub,
             )
             # The staging cache now holds the prompt's EXACT rows (the
             # quantized copy went into the slot) — publish before the
@@ -952,7 +1258,8 @@ class SlotServer:
             self._publish_prefix(slot)
         else:
             self._staging = self._stage_chunk(
-                self.params, jnp.asarray(mat), n_vec, self._staging, reset
+                self.params, jnp.asarray(mat), n_vec, self._staging,
+                reset, reset_val,
             )
 
     def _retire(self, slot: int, tick: int, outcome: str,
@@ -996,6 +1303,24 @@ class SlotServer:
             # The request's pinned prefix path becomes evictable.
             self._prefix.release(self._slot_nodes[slot])
             self._slot_nodes[slot] = []
+        if self._paged:
+            # Blocks the tree adopted stay cached (pins just dropped);
+            # the slot's remaining private blocks — decode tail, partial
+            # prompt block, unpublished spans — go back to the free list,
+            # along with any unspent worst-case reservation (early EOS).
+            for bid in self._slot_private[slot]:
+                self._pool.free_private(bid)
+            self._slot_private[slot] = set()
+            if self._slot_reserve[slot]:
+                self._pool.unreserve(self._slot_reserve[slot])
+                self._slot_reserve[slot] = 0
+            self._host_table[slot, :] = 0  # stale ids must never be read
+            self._slot_nblocks[slot] = 0
+            self._table_dirty = True
+            # The pin releases above can grow EVICTABILITY without
+            # touching the free list — clear the admit loop's deferral
+            # latch so the queue head retries.
+            self._pool.gen += 1
         if obs.REGISTRY.enabled:
             _REQUESTS.labels(outcome=outcome).inc()
 
@@ -1017,6 +1342,10 @@ class SlotServer:
         occupancy = 0
         tokens = 0
         prefix0 = self._prefix.stats() if self._prefix is not None else None
+        hit_bytes0 = self._hit_bytes_moved
+        if self._paged:
+            self._peak_blocks_used = self._pool.used
+            self._defer_gen = -1  # stale latch must not defer a fresh run
         t0 = time.monotonic()
 
         try:
@@ -1048,11 +1377,30 @@ class SlotServer:
                 while free and pending and pending[0].arrival_tick <= tick:
                     if self._staged_prefill and self._prefill_fifo:
                         break
+                    resv = None
+                    if self._paged:
+                        # Worst-case block reservation (minus what a
+                        # prefix hit shares). Failure DEFERS: the
+                        # request stays queued — FIFO, no skip-ahead —
+                        # until retires/evictions free blocks. This is
+                        # what lets --slots exceed the pool's contiguous
+                        # equivalent instead of failing on a shape. The
+                        # generation latch skips the O(prompt) re-match
+                        # + O(tree) evictability recount on ticks where
+                        # availability cannot have grown since the last
+                        # failed attempt.
+                        if self._defer_gen == self._pool.gen:
+                            break
+                        resv = self._paged_reserve(pending[0])
+                        if resv is None:
+                            self._defer_gen = self._pool.gen
+                            break
                     req = pending.popleft()
                     slot = free.pop(0)
                     visible -= 1
                     vis = visible_wall.setdefault(req.uid, now)
-                    wait_ledger[req.uid] = self._admit(req, slot, tick, vis)
+                    wait_ledger[req.uid] = self._admit(req, slot, tick,
+                                                       vis, resv)
                 queue_depth = visible  # visible but still unadmitted
 
                 # Plan this tick's prefill chunks (chunked admission only).
@@ -1098,20 +1446,31 @@ class SlotServer:
                         mat = np.zeros((self.slots, tq), np.int32)
                         n_vec = np.zeros((self.slots,), np.int32)
                         reset = np.zeros((self.slots,), bool)
+                        reset_val = np.zeros((self.slots,), np.int32)
                         emit = np.zeros((self.slots,), bool)
                         for i in live_idx:
+                            self._ensure_blocks(
+                                i, len(self._slot_req[i].prompt)
+                                + len(self._slot_tokens[i])
+                            )
                             mat[i, 0] = self._tok_host[i]
                             n_vec[i] = 1
                             emit[i] = True
                         for slot, n, last in plan:
+                            self._ensure_blocks(
+                                slot, self._prefill_pos[slot] + n
+                            )
                             rows, first = self._consume_chunk(slot, n, last)
                             mat[slot, :n] = rows
                             n_vec[slot] = n
                             reset[slot] = first
+                            reset_val[slot] = self._prefill_start[slot]
                             emit[slot] = last
+                        self._sync_table()
                         self.tok, self.cache, self._key = self._mixed(
                             self.params, jnp.asarray(mat),
                             jnp.asarray(n_vec), jnp.asarray(reset),
+                            jnp.asarray(reset_val),
                             jnp.asarray(emit), self.cache, self._key,
                         )
                         stepped = True
@@ -1131,10 +1490,17 @@ class SlotServer:
                         emit = np.zeros((self.slots,), bool)
                         n_vec[live_idx] = 1
                         emit[live_idx] = True
+                        for i in live_idx:
+                            self._ensure_blocks(
+                                i, len(self._slot_req[i].prompt)
+                                + len(self._slot_tokens[i])
+                            )
+                        self._sync_table()
                         self.tok, self.cache, self._key = self._mixed(
                             self.params, self.tok[:, None],
                             jnp.asarray(n_vec),
                             jnp.zeros((self.slots,), bool),
+                            jnp.zeros((self.slots,), jnp.int32),
                             jnp.asarray(emit), self.cache, self._key,
                         )
                         stepped = True
@@ -1212,10 +1578,15 @@ class SlotServer:
                         tick_span.set(host_sync=host_sync,
                                       tokens=tokens_this_tick)
 
+                if self._paged:
+                    if self._pool.used > self._peak_blocks_used:
+                        self._peak_blocks_used = self._pool.used
+                    self._pool.publish_gauges()  # registry-guarded inside
+
                 # The flight recorder's per-tick record (the black box a
                 # post-mortem replays); record dict built only when armed.
                 if FLIGHT.enabled:
-                    FLIGHT.record({
+                    rec = {
                         "tick": tick,
                         "t_s": round(now - t0, 6),
                         "occupancy": len(live_idx),
@@ -1233,7 +1604,28 @@ class SlotServer:
                         "pending": len(pending),
                         "prefix_hits": self._tick_prefix_hits,
                         "prefix_reused": self._tick_prefix_reused,
-                    })
+                    }
+                    if self._paged:
+                        # Block occupancy + internal fragmentation (the
+                        # fraction of mapped block capacity no written
+                        # token occupies) — the paged black-box truths.
+                        mapped = sum(self._slot_nblocks)
+                        written = 0
+                        for i in range(self.slots):
+                            st = self._slot_state[i]
+                            if st == "prefill":
+                                written += self._prefill_pos[i]
+                            elif st in ("await", "live"):
+                                written += (
+                                    len(self._slot_req[i].prompt)
+                                    + max(len(self._slot_tokens[i]) - 1, 0)
+                                )
+                        rec["kv_blocks_used"] = self._pool.used
+                        rec["kv_blocks_free"] = self._pool.free_count
+                        rec["kv_frag"] = round(
+                            1.0 - written / (mapped * self.kv_block), 4
+                        ) if mapped else 0.0
+                    FLIGHT.record(rec)
                 self.slo.maybe_export(now)
 
                 if host_sync or stepped or ran_staged:
@@ -1282,6 +1674,20 @@ class SlotServer:
                 "evictions": p1["evictions"] - prefix0["evictions"],
                 "pool_blocks_used": p1["pool_blocks_used"],
                 "pool_blocks": p1["pool_blocks"],
+                # Device KV bytes the run's hits copied pool->slot: the
+                # gather cost under the contiguous layout, identically 0
+                # under paged exact serving (reference-in-place).
+                "hit_bytes_moved": self._hit_bytes_moved - hit_bytes0,
+            }
+        kv_snap: Dict[str, Any] = {}
+        if self._paged:
+            kv_snap = {
+                "layout": "paged",
+                "block": self.kv_block,
+                "pool_blocks": self.kv_blocks,
+                "blocks_used": self._pool.used,
+                "blocks_free": self._pool.free_count,
+                "peak_blocks_used": self._peak_blocks_used,
             }
         log.info(
             "served %d request(s): %d tokens over %d decode tick(s), "
@@ -1299,4 +1705,5 @@ class SlotServer:
             tbt_s=tbt,
             slo=slo_snap,
             prefix=prefix_snap,
+            kv=kv_snap,
         )

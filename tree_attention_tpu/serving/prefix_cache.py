@@ -53,6 +53,7 @@ from tree_attention_tpu.models.decode import (
     extract_prefix_blocks,
     insert_prefix_blocks,
 )
+from tree_attention_tpu.serving.block_pool import BlockAllocator
 from tree_attention_tpu.models.transformer import TransformerConfig
 from tree_attention_tpu.utils.logging import get_logger
 
@@ -79,6 +80,15 @@ _POOL_USED = obs.gauge(
 )
 
 
+def _block_key(toks: List[int], j: int, block: int) -> Tuple[int, ...]:
+    """The radix key of block ``j``: that span's token tuple. Callers on
+    the admission hot path convert the prompt with ONE ``tolist()`` and
+    slice here at C speed — per-element ``int()`` over numpy scalars
+    measured slower than the device gather the paged hit replaces, which
+    would have made the host the new bottleneck."""
+    return tuple(toks[j * block:(j + 1) * block])
+
+
 class _Node:
     """One radix node: a ``block``-token span owning one pool block."""
 
@@ -94,7 +104,92 @@ class _Node:
         self.last_use = 0
 
 
-class PrefixCache:
+class _RadixBase:
+    """The radix walk/pin/LRU machinery BOTH prefix indexes share.
+
+    One definition of the discipline — pin-as-you-visit, LRU touch, the
+    one-suffix-token match cap, refcount-0-leaf victim selection, the
+    hit/miss stats vocabulary — so the gather-based :class:`PrefixCache`
+    and the reference-in-place :class:`PagedPrefixIndex` can never
+    silently diverge on it.
+    """
+
+    def _init_tree(self, block: int) -> None:
+        if block < 1 or block & (block - 1):
+            raise ValueError(f"prefix block must be a power of two, "
+                             f"got {block}")
+        self.block = block
+        self._root = _Node((), None, -1)
+        self._clock = 0
+        # Run/lifetime stats (host truths; the engine snapshots + diffs
+        # these per serve() run for its report).
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    def _key(self, prompt: np.ndarray, j: int) -> Tuple[int, ...]:
+        return _block_key(prompt.tolist(), j, self.block)
+
+    def _pinned_walk(self, prompt: np.ndarray) -> List[_Node]:
+        """Pin + LRU-touch the longest cached path over the prompt's
+        matchable blocks — capped at ``len(prompt) - 1`` tokens, because
+        sampling the first output token needs at least one forward row."""
+        max_blocks = (len(prompt) - 1) // self.block
+        toks = prompt.tolist()  # ONE C-speed convert; see _block_key
+        node = self._root
+        path: List[_Node] = []
+        for j in range(max_blocks):
+            child = node.children.get(_block_key(toks, j, self.block))
+            if child is None:
+                break
+            child.refs += 1
+            self._touch(child)
+            path.append(child)
+            node = child
+        return path
+
+    def record_match(self, matched: int) -> None:
+        """Count one admission's match outcome (stats + guarded
+        counters). Separate from the walk so a caller that may DEFER the
+        admission (the paged engine's reservation check) records only
+        admissions that actually proceed."""
+        if matched:
+            self.hits += 1
+            self.tokens_reused += matched
+            if obs.REGISTRY.enabled:
+                _HITS.inc()
+                _TOKENS_REUSED.inc(matched)
+        else:
+            self.misses += 1
+            if obs.REGISTRY.enabled:
+                _MISSES.inc()
+
+    def release(self, nodes: List[_Node]) -> None:
+        for n in nodes:
+            n.refs -= 1
+            assert n.refs >= 0, "prefix node ref underflow"
+
+    def _lru_leaf(self) -> Optional[_Node]:
+        """The least-recently-used refcount-0 leaf, or None when every
+        block is pinned (directly or through a pinned descendant)."""
+        best: Optional[_Node] = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.children or n.refs:
+                continue
+            if best is None or n.last_use < best.last_use:
+                best = n
+        return best
+
+
+class PrefixCache(_RadixBase):
     """Device block pool + host radix tree over prompt prefixes.
 
     Args:
@@ -113,12 +208,9 @@ class PrefixCache:
         blocks: int = 64,
         mesh: Optional[Mesh] = None,
     ):
-        if block < 1 or block & (block - 1):
-            raise ValueError(f"prefix block must be a power of two, "
-                             f"got {block}")
+        self._init_tree(block)
         if blocks < 1:
             raise ValueError(f"prefix pool needs >= 1 block, got {blocks}")
-        self.block = block
         self.blocks = blocks
         shape = (blocks, cfg.n_layers, cfg.n_kv_heads, block, cfg.d_head)
         if mesh is not None:
@@ -131,15 +223,7 @@ class PrefixCache:
         else:
             self.pool_k = jnp.zeros(shape, cfg.dtype)
             self.pool_v = jnp.zeros(shape, cfg.dtype)
-        self._root = _Node((), None, -1)
         self._free: List[int] = list(range(blocks))
-        self._clock = 0
-        # Run/lifetime stats (host truths; the engine snapshots + diffs
-        # these per serve() run for its report).
-        self.hits = 0
-        self.misses = 0
-        self.tokens_reused = 0
-        self.evictions = 0
         self._copy = jax.jit(insert_prefix_blocks, donate_argnums=(0,))
         self._publish = jax.jit(extract_prefix_blocks, donate_argnums=(0, 1))
 
@@ -159,49 +243,16 @@ class PrefixCache:
             "pool_blocks": self.blocks,
         }
 
-    def _touch(self, node: _Node) -> None:
-        self._clock += 1
-        node.last_use = self._clock
-
-    def _key(self, prompt: np.ndarray, j: int) -> Tuple[int, ...]:
-        return tuple(
-            int(t) for t in prompt[j * self.block:(j + 1) * self.block]
-        )
-
     def match(self, prompt: np.ndarray) -> Tuple[int, List[_Node]]:
         """Longest cached prefix of ``prompt`` in whole blocks, capped so
         at least one suffix token remains. Returns ``(matched_tokens,
         path)`` with every path node ref-pinned and LRU-touched — the
         caller owns the refs until it calls :meth:`release` (the serving
         engine holds them for the request's lifetime)."""
-        max_blocks = (len(prompt) - 1) // self.block
-        node = self._root
-        path: List[_Node] = []
-        for j in range(max_blocks):
-            child = node.children.get(self._key(prompt, j))
-            if child is None:
-                break
-            child.refs += 1
-            self._touch(child)
-            path.append(child)
-            node = child
+        path = self._pinned_walk(prompt)
         matched = len(path) * self.block
-        if matched:
-            self.hits += 1
-            self.tokens_reused += matched
-            if obs.REGISTRY.enabled:
-                _HITS.inc()
-                _TOKENS_REUSED.inc(matched)
-        else:
-            self.misses += 1
-            if obs.REGISTRY.enabled:
-                _MISSES.inc()
+        self.record_match(matched)
         return matched, path
-
-    def release(self, nodes: List[_Node]) -> None:
-        for n in nodes:
-            n.refs -= 1
-            assert n.refs >= 0, "prefix node ref underflow"
 
     def insert(self, prompt: np.ndarray) -> Tuple[List[_Node], List[int],
                                                   int]:
@@ -217,11 +268,12 @@ class PrefixCache:
         the block index their data starts at.
         """
         nb_full = len(prompt) // self.block
+        toks = prompt.tolist()
         node = self._root
         path: List[_Node] = []
         j = 0
         while j < nb_full:
-            child = node.children.get(self._key(prompt, j))
+            child = node.children.get(_block_key(toks, j, self.block))
             if child is None:
                 break
             child.refs += 1
@@ -237,7 +289,7 @@ class PrefixCache:
                 log.debug("prefix pool pinned full; publish stops at "
                           "block %d/%d", j, nb_full)
                 break
-            child = _Node(self._key(prompt, j), node, bid)
+            child = _Node(_block_key(toks, j, self.block), node, bid)
             child.refs = 1
             self._touch(child)
             node.children[child.key] = child
@@ -257,20 +309,6 @@ class PrefixCache:
         if obs.REGISTRY.enabled:
             _POOL_USED.set(self.blocks_used)
         return bid
-
-    def _lru_leaf(self) -> Optional[_Node]:
-        """The least-recently-used refcount-0 leaf, or None when every
-        block is pinned (directly or through a pinned descendant)."""
-        best: Optional[_Node] = None
-        stack = list(self._root.children.values())
-        while stack:
-            n = stack.pop()
-            stack.extend(n.children.values())
-            if n.children or n.refs:
-                continue
-            if best is None or n.last_use < best.last_use:
-                best = n
-        return best
 
     def _evict(self, node: _Node) -> None:
         assert not node.children and node.refs == 0
@@ -319,3 +357,176 @@ class PrefixCache:
             self.pool_k, self.pool_v, cache.k, cache.v,
             jnp.int32(slot), jnp.asarray(ids), jnp.int32(start_block),
         )
+
+
+class PagedPrefixIndex(_RadixBase):
+    """Radix prefix index over the UNIFIED paged pool — reference in place.
+
+    The paged mirror of :class:`PrefixCache`: the same host radix tree at
+    ``block``-token granularity, the same pin/LRU-leaf discipline, but
+    nodes reference blocks of the ONE pool every slot already reads
+    through its block table (:class:`~tree_attention_tpu.models.decode
+    .PagedKVCache`), so both halves of prefix reuse move ZERO device
+    bytes:
+
+    - a **hit** pins the matched path and hands the engine its block ids;
+      the engine writes them into the slot's table row — a host-side
+      integer update where the contiguous path paid a pool→slot gather;
+    - a **publish** ADOPTS the prefilling slot's private blocks
+      (:meth:`adopt`): ownership moves to the tree via the allocator's
+      ledger, the KV bytes stay exactly where the prefill wrote them.
+
+    ``max_cached`` bounds how many blocks the tree may retain (the
+    deprecated ``prefix_pool_blocks`` view of the world — useful for
+    tests and for bounding cold-cache memory); ``None`` lets retention
+    grow to whatever the pool's eviction pressure allows. The index
+    registers itself as the allocator's evictor, so slot allocations
+    under a full free list recycle LRU refcount-0 leaves automatically.
+    """
+
+    def __init__(self, *, block: int, alloc: "BlockAllocator",
+                 max_cached: Optional[int] = None):
+        self._init_tree(block)
+        self.alloc = alloc
+        self.max_cached = max_cached
+        self._cached = 0  # blocks the tree currently owns
+        alloc.set_evictor(self.evict_one, self.evictable_blocks)
+
+    # -- stats (same vocabulary as PrefixCache; the engine snapshots) -----
+
+    @property
+    def blocks_used(self) -> int:
+        return self._cached
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "tokens_reused": self.tokens_reused,
+            "evictions": self.evictions,
+            "pool_blocks_used": self._cached,
+            "pool_blocks": (self.max_cached if self.max_cached is not None
+                            else self.alloc.blocks),
+        }
+
+    # -- match / pin (identical contract to PrefixCache.match) ------------
+
+    def match(self, prompt: np.ndarray,
+              record: bool = True) -> Tuple[int, List[_Node]]:
+        """Longest cached prefix in whole blocks (capped so one suffix
+        token remains), path ref-pinned and LRU-touched; the caller holds
+        the pins admit→retire and reads KV through ``node.block_id`` —
+        no copy, no staging, zero device bytes. ``record=False`` defers
+        the hit/miss stats to :meth:`record_match`: the engine matches
+        BEFORE it knows whether the admission's block reservation fits,
+        and a deferred admission re-matches later (double-counting the
+        monotonic counters would corrupt the reuse accounting)."""
+        path = self._pinned_walk(prompt)
+        matched = len(path) * self.block
+        if record:
+            self.record_match(matched)
+        return matched, path
+
+    # -- publish by adoption ----------------------------------------------
+
+    def adopt(self, prompt: np.ndarray, phys: Dict[int, int],
+              held: List[_Node]) -> Tuple[List[_Node], List[int]]:
+        """Publish a completed prompt by HANDING OVER the slot's blocks.
+
+        ``phys`` maps the prompt's logical block index ``j`` to the
+        physical pool block the slot privately owns there; ``held`` is
+        the request's admit-pinned matched path (its pins CARRY OVER —
+        adopt neither re-pins nor releases them). Walking past the held
+        prefix: a missing node adopts ``phys[j]`` (ownership moves to
+        the tree, refs=1 held by this request until retire); a node
+        another request published since our admit is walked THROUGH with
+        only a call-scoped guard pin — the slot keeps reading its own
+        private copy (identical bytes, freed at retire), and a
+        PERSISTENT pin on a refcount-0 node here could convert a block
+        some admission's reservation is backed by from evictable to
+        pinned, stranding that reservation (the allocator's one
+        soundness invariant). The guard pin exists because the budget
+        eviction below picks LRU refcount-0 LEAVES — without it, the
+        very leaf the walk is standing on could be evicted mid-adopt,
+        and the new child would attach under a detached parent (an
+        orphaned subtree whose block leaks). Dropped before returning,
+        so availability accounting is untouched. Adoption stops early
+        when the retention budget is pinned full — partial paths are
+        valid prefixes, exactly like PrefixCache's pinned-pool publish
+        stop. Returns ``(path, adopted_logical)``: the pinned nodes
+        this request now holds (held + created) and which logical
+        blocks changed owner.
+        """
+        nb_full = len(prompt) // self.block
+        toks = prompt.tolist()
+        node = held[-1] if held else self._root
+        path: List[_Node] = list(held)
+        adopted: List[int] = []
+        guard: List[_Node] = []  # call-scoped pins on walked-through nodes
+        for j in range(len(held), nb_full):
+            key = _block_key(toks, j, self.block)
+            child = node.children.get(key)
+            if child is None:
+                bid = phys.get(j)
+                if bid is None:
+                    break  # the slot holds no private block here
+                if self.max_cached is not None \
+                        and self._cached >= self.max_cached:
+                    if not self.evict_one():
+                        log.debug("prefix index pinned full; publish "
+                                  "stops at block %d/%d", j, nb_full)
+                        break
+                child = _Node(key, node, bid)
+                child.refs = 1
+                self.alloc.publish(bid)
+                self._cached += 1
+                adopted.append(j)
+                node.children[key] = child
+                path.append(child)
+            else:
+                child.refs += 1
+                guard.append(child)
+            self._touch(child)
+            node = child
+        self.release(guard)
+        if obs.REGISTRY.enabled:
+            _POOL_USED.set(self._cached)
+        return path, adopted
+
+    # -- eviction (the allocator's hook) ----------------------------------
+
+    def evict_one(self) -> bool:
+        """Free one LRU refcount-0 leaf into the allocator; False when
+        every cached block is pinned (directly or through a pinned
+        descendant)."""
+        victim = self._lru_leaf()
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        self.alloc.free_cached(victim.block_id)
+        self._cached -= 1
+        self.evictions += 1
+        if obs.REGISTRY.enabled:
+            _POOL_USED.set(self._cached)
+        return True
+
+    def evictable_blocks(self) -> int:
+        """Blocks in fully-unpinned subtrees — exactly what repeated
+        :meth:`evict_one` calls can reach (leaf-first eviction drains an
+        unpinned subtree completely; a pinned descendant protects every
+        ancestor on its path)."""
+
+        def walk(node: _Node) -> Tuple[bool, int, int]:
+            has_pin = node.refs > 0
+            blocks = 1
+            kid_evictable = 0
+            for c in node.children.values():
+                p, b, e = walk(c)
+                has_pin |= p
+                blocks += b
+                kid_evictable += e
+            if has_pin:
+                return True, blocks, kid_evictable
+            return False, blocks, blocks
+
+        return sum(walk(c)[2] for c in self._root.children.values())

@@ -90,9 +90,17 @@ class RunConfig:
     slo_tbt: float = 0.2     # worst inter-token-gap target (s), ditto
     prefix_cache: bool = False  # radix prefix KV reuse across requests
     prefix_block: int = 64   # pool block granularity (tokens, pow2)
-    prefix_pool_blocks: int = 64  # device pool capacity in blocks
+    # DEPRECATED (ISSUE 6): the paged layout has ONE --kv-blocks budget;
+    # a value given here feeds it (with a warning). Contiguous layout
+    # still uses it as the separate prefix pool's size (default 64).
+    prefix_pool_blocks: Optional[int] = None
     prefix_share: float = 0.0  # trace: fraction of requests sharing a prefix
     prefix_len: int = 0      # trace: shared prefix length (tokens)
+    kv_layout: str = "paged"  # paged (one block pool) | contiguous (PR-5)
+    kv_block: Optional[int] = None  # tokens per pool block (pow2; None ->
+    #                                 prefix-block with the cache on, else 64)
+    kv_blocks: Optional[int] = None  # TOTAL pool capacity in blocks (None ->
+    #                                  slots * ceil(cache_len / kv_block))
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -274,8 +282,33 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(power of two; the match/publish granularity)")
     p.add_argument("--prefix-pool-blocks", type=int,
                    default=d.prefix_pool_blocks,
-                   help="serve mode: prefix pool capacity in blocks "
-                        "(refcount-0 blocks are LRU-evicted)")
+                   help="DEPRECATED: use the unified --kv-blocks budget. "
+                        "Under --kv-layout paged a value given here is "
+                        "added onto the derived --kv-blocks total (the "
+                        "old slot-cache + prefix-pool split, preserved "
+                        "byte-for-byte) with a warning; under "
+                        "--kv-layout contiguous it still sizes the "
+                        "separate prefix pool (default 64)")
+    p.add_argument("--kv-layout", choices=["paged", "contiguous"],
+                   default=d.kv_layout,
+                   help="serve mode: 'paged' (default) holds every "
+                        "slot's KV as a block table over ONE ref-counted "
+                        "pool (PagedAttention, arXiv:2309.06180) — "
+                        "copy-free prefix hits, on-demand allocation, "
+                        "admissions defer when the pool is full; "
+                        "'contiguous' keeps the per-slot regions + "
+                        "gather hits")
+    p.add_argument("--kv-block", type=int, default=d.kv_block,
+                   help="serve mode: tokens per KV pool block (power of "
+                        "two; default --prefix-block with the prefix "
+                        "cache on, else 64)")
+    p.add_argument("--kv-blocks", type=int, default=d.kv_blocks,
+                   help="serve mode: TOTAL paged pool capacity in blocks "
+                        "— the one KV memory budget slots and the prefix "
+                        "cache share (default: slots * ceil(cache_len / "
+                        "kv_block), the contiguous layout's bytes). "
+                        "Smaller over-subscribes: admissions wait for "
+                        "free blocks instead of failing")
     p.add_argument("--prefix-share", type=float, default=d.prefix_share,
                    help="serve mode: fraction of the synthetic trace's "
                         "requests drawing their prompt head from a shared "
