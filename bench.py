@@ -635,6 +635,20 @@ def _serving_prefix_record():
     return bench_serving_prefix_flood()
 
 
+def _serving_spec_record():
+    """Speculative decoding (ISSUE 8): decode tokens/sec per slot with
+    draft-and-verify on vs off over a repetitive/templated trace
+    (arXiv:2211.17192; token-tree drafts under the tree-attention mask,
+    SpecInfer arXiv:2305.09781) — plus the chain_slope-priced verify-tick
+    cost the accepted bursts must amortise. Parity-gated: the committed
+    streams are asserted token-identical before any number is reported.
+    CPU proxy; the fewer-fatter-ticks structure transfers. See
+    tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_speculative
+
+    return bench_serving_speculative()
+
+
 def _serving_paged_record():
     """Paged KV flood (ISSUE 6): paged vs contiguous layouts at EQUAL
     pool bytes over the PR-5 shared-prefix flood — the chain_slope-priced
@@ -881,6 +895,7 @@ def _run_suite() -> None:
     run("serving_chunked_prefill_flood", _serving_flood_record)
     run("serving_prefix_flood", _serving_prefix_record)
     run("serving_paged_flood", _serving_paged_record)
+    run("serving_speculative", _serving_spec_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1003,6 +1018,15 @@ def _summarize_record(name, rec):
         moved = trace.get("paged", {}).get("hit_bytes_moved")
         if moved is not None:
             out["paged_hit_bytes_moved"] = moved
+    if name == "serving_speculative":
+        trace = rec.get("trace", {})
+        for key in ("tokens_per_sec_improvement",
+                    "tree_tokens_per_sec_improvement"):
+            if key in trace:
+                out[key] = trace[key]
+        acc = trace.get("on", {}).get("acceptance_rate")
+        if acc is not None:
+            out["acceptance_rate"] = acc
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

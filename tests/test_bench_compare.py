@@ -44,6 +44,19 @@ _SPEC.loader.exec_module(bc)
     ("us_per_prefix_gather", bc.SMALLER_IS_BETTER),
     ("tokens_per_sec", bc.LARGER_IS_BETTER),
     ("collective_dispatch_total", bc.EXACT),
+    # Speculative-decoding family (ISSUE 8): acceptance ratios and
+    # committed-tokens-per-verify regress like other quality ratios
+    # (larger-is-better, 20% rtol); the verify tick's COST ratio is
+    # smaller-is-better; workload echoes skip.
+    ("acceptance_rate", bc.LARGER_IS_BETTER),
+    ("accepted", bc.LARGER_IS_BETTER),
+    ("tokens_per_verify", bc.LARGER_IS_BETTER),
+    ("tokens_per_sec_per_slot", bc.LARGER_IS_BETTER),
+    ("verify_tick_cost_ratio", bc.SMALLER_IS_BETTER),
+    ("us_per_verify_tick", bc.SMALLER_IS_BETTER),
+    ("draft_k", None),
+    ("verify_bucket", None),
+    ("verify_ticks", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family

@@ -713,12 +713,22 @@ class TestDisabledOverhead:
         tracer = SpanTracer()  # inactive
         flight = FlightRecorder()  # disarmed
         tick_rec = {"tick": 0}  # prebuilt, as the engine's guard requires
+        # The speculative-decoding hooks (ISSUE 8) ride the same guard:
+        # the engine's verify commit calls these module-level metrics
+        # only under REGISTRY.enabled — exercised here through the real
+        # objects (registered on the global, disabled registry).
+        from tree_attention_tpu.serving.engine import (
+            _SPEC_ACCEPTED, _SPEC_ACCEPT_RATIO, _SPEC_PROPOSED,
+        )
 
         def hot_path():
             c.inc()
             child.inc(3)
             g.set(2.0)
             h.observe(0.5)
+            _SPEC_PROPOSED.inc(4)
+            _SPEC_ACCEPTED.inc(2)
+            _SPEC_ACCEPT_RATIO.set(0.5)
             with tracer.span("phase"):
                 pass
             tracer.instant("event")

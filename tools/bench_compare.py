@@ -57,7 +57,14 @@ _LARGER_SUBSTRINGS = (
     "tokens_per_sec", "flops_per_sec", "speedup", "improvement",
     "goodput", "roofline_frac", "stall_ratio", "avoided_ratio",
     "reused_ratio", "hit_rate", "max_concurrent",
+    # Speculative-decoding family (ISSUE 8): acceptance_rate /
+    # accepted counts and committed-tokens-per-verify are ratio-like
+    # quality metrics — 20% rtol, larger is better.
+    "accept", "tokens_per_verify",
 )
+# Ratio-shaped keys where SMALLER is better (checked before the
+# larger-is-better substrings — "cost" beats "ratio").
+_SMALLER_SUBSTRINGS = ("cost_ratio",)
 _EXACT_SUFFIXES = ("_total", "_bytes", "_count")
 _SMALLER_SUFFIXES = ("_us", "_s", "_seconds", "_ms")
 _SMALLER_EXACT_KEYS = ("median", "mean", "wall_s", "p50", "p95", "p99")
@@ -80,6 +87,8 @@ def classify(key: str) -> Optional[str]:
     k = key.lower()
     if k in _IGNORE_KEYS:
         return None
+    if any(s in k for s in _SMALLER_SUBSTRINGS):
+        return SMALLER_IS_BETTER
     if any(s in k for s in _LARGER_SUBSTRINGS):
         return LARGER_IS_BETTER
     if k.endswith(_EXACT_SUFFIXES) or k.startswith("n_"):

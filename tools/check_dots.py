@@ -30,9 +30,9 @@ import sys
 # prefill), 278 after PR 4 (serving observability plane; 279 measured),
 # 316 after PR 5 (radix prefix KV cache; 317 measured), 337 after PR 6
 # (paged KV; 338 measured, rc 0 — the five env-impossible test_cli
-# launch tests are conftest-skipped on legacy jaxlib now). Raise as PRs
-# add tests.
-FLOOR = 337
+# launch tests are conftest-skipped on legacy jaxlib now), 385 after
+# PR 7 (speculative decoding; 386 measured). Raise as PRs add tests.
+FLOOR = 385
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

@@ -979,3 +979,182 @@ def bench_serving_prefix_flood(
         "slope": slope_rec,
         "trace": trace_rec,
     }
+
+
+def _repetitive_trace(n_requests: int, *, prompt_len: int, max_new: int,
+                      vocab: int, seed: int = 0) -> List[Request]:
+    """Templated/repetitive prompts (short repeating patterns): the
+    workload prompt-lookup speculation exists for. The tiny bench model's
+    greedy continuation settles into an attractor loop after a short
+    wander, and the n-gram drafter then predicts it near-perfectly —
+    the high-acceptance regime, produced honestly by the model itself
+    rather than by scripting its output."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n_requests):
+        pat = rng.integers(0, vocab, size=int(rng.integers(2, 5)))
+        prompt = np.tile(pat, -(-prompt_len // len(pat)))[:prompt_len]
+        reqs.append(Request(uid=i, prompt=prompt.astype(np.int32),
+                            max_new_tokens=max_new))
+    return reqs
+
+
+def bench_serving_speculative(
+    *,
+    slots: int = 2,
+    n_requests: int = 4,
+    prompt_len: int = 24,
+    max_new: int = 256,
+    cache_len: int = 320,
+    draft_k: int = 7,
+    repeats: int = 3,
+    cfg: Optional[TransformerConfig] = None,
+    seed: int = 3,
+) -> Dict[str, Any]:
+    """The speculative-decoding record (ISSUE 8): decode tokens/sec per
+    slot with draft-and-verify on vs off, on a repetitive/templated trace
+    where acceptance is high.
+
+    Three measurements:
+
+    - **Slope** — chain_slope prices the two per-tick programs: the plain
+      decode tick (Tq=1) and the verify-shaped mixed tick at the spec
+      bucket (Tq = pow2(draft_k+1)). ``verify_tick_cost_ratio`` is the
+      padded verify step's cost over the decode step's — what a verify
+      must amortise; at acceptance α it commits ``1 + α·draft_k`` tokens,
+      so the structural speedup is ``(1 + α·draft_k) /
+      verify_tick_cost_ratio``.
+    - **Trace** — the real engine over the identical trace with
+      ``speculate`` off, on (``ngram``), and on with token-tree drafts
+      (``ngram-tree``), ``repeats`` timed runs each on a warmed server,
+      best-over-repeats tokens/sec (the noise-robust larger-is-better
+      sample). ``tokens_per_sec_improvement`` (the headline, >= 2x at
+      high acceptance on this box) and the run's measured
+      ``acceptance_rate`` / ``tokens_per_verify`` come straight from the
+      engine's verify accounting.
+    - **Parity** — the committed streams of all three runs are asserted
+      token-identical before any number is reported: a speculative
+      speedup that changed a single token would be a wrong answer fast.
+
+    CPU proxy by design: per-tick fixed cost dominates this model, which
+    is exactly the structure speculation attacks (fewer, fatter ticks);
+    the acceptance machinery transfers unchanged. The default model is
+    deliberately small (d=64, vocab=128): its greedy continuations
+    settle into attractor loops quickly, giving the high-acceptance
+    regime from the model's own honest outputs — measured ~0.86
+    acceptance / 2.7x tok/s at the defaults on this box (the wider
+    serving_model_config default wanders too long to accept much; real
+    templated traffic is the production analogue).
+    """
+    import time as _time
+
+    cfg = cfg or serving_model_config(
+        max_seq_len=cache_len, vocab_size=128, d_model=64
+    )
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+
+    # --- slope: decode tick vs verify-shaped tick ---
+    bucket = 8
+    while bucket < draft_k + 1:
+        bucket *= 2
+    lens = _ragged_lengths(slots, cache_len)
+    np.minimum(lens, cache_len - bucket, out=lens)
+    with obs.span("bench_serving_speculative:slope", cat="bench"):
+        s_decode = slope_decode_step(
+            params, cfg, slots=slots, cache_len=cache_len, lengths=lens
+        )
+        s_verify = slope_mixed_tick(
+            params, cfg, slots=slots, cache_len=cache_len, chunk=bucket,
+            lengths=lens,
+        )
+    cost_ratio = (
+        s_verify.per_step / s_decode.per_step if s_decode.per_step else 0.0
+    )
+    slope_rec = {
+        "us_per_decode_tick": round(s_decode.per_step * 1e6, 1),
+        "us_per_verify_tick": round(s_verify.per_step * 1e6, 1),
+        "verify_bucket": bucket,
+        "verify_tick_cost_ratio": round(cost_ratio, 3),
+    }
+
+    # --- trace: off vs ngram vs ngram-tree, parity-gated ---
+    def run_mode(label: str, **spec_kw) -> Dict[str, Any]:
+        server = SlotServer(
+            params, cfg, slots=slots, cache_len=cache_len, **spec_kw
+        )
+        reqs = _repetitive_trace(
+            n_requests, prompt_len=prompt_len, max_new=max_new,
+            vocab=cfg.vocab_size, seed=seed + 1,
+        )
+        server.serve([dataclasses.replace(r) for r in reqs])  # warm jits
+        best: Optional[Dict[str, Any]] = None
+        toks = None
+        for _ in range(repeats):
+            t0 = _time.monotonic()
+            rep = server.serve([dataclasses.replace(r) for r in reqs])
+            wall = _time.monotonic() - t0
+            toks = {r.uid: r.tokens for r in rep.results}
+            cell = {
+                "tokens_per_sec": round(rep.tokens_generated / wall, 1),
+                "tokens_per_sec_per_slot": round(
+                    rep.tokens_generated / wall / slots, 1
+                ),
+                "ticks": rep.ticks,
+                "wall_s": round(wall, 4),
+            }
+            if rep.spec:
+                cell["acceptance_rate"] = rep.spec["acceptance_rate"]
+                cell["tokens_per_verify"] = rep.spec["tokens_per_verify"]
+            if best is None or (cell["tokens_per_sec"]
+                                > best["tokens_per_sec"]):
+                best = cell
+        best["label"] = label
+        return best, toks
+
+    with obs.span("bench_serving_speculative:trace", cat="bench"):
+        off, toks_off = run_mode("off")
+        on, toks_on = run_mode(
+            "ngram", speculate=True, draft_k=draft_k, drafter="ngram"
+        )
+        tree, toks_tree = run_mode(
+            "ngram-tree", speculate=True, draft_k=draft_k,
+            drafter="ngram-tree",
+        )
+    for label, got in (("ngram", toks_on), ("ngram-tree", toks_tree)):
+        assert got == toks_off, (
+            f"PARITY VIOLATION: speculative run ({label}) changed tokens"
+        )
+    trace_rec: Dict[str, Any] = {"off": off, "on": on, "tree": tree,
+                                 "parity": "token-identical"}
+    if off["tokens_per_sec"] > 0:
+        trace_rec["tokens_per_sec_improvement"] = round(
+            on["tokens_per_sec"] / off["tokens_per_sec"], 2
+        )
+        trace_rec["tree_tokens_per_sec_improvement"] = round(
+            tree["tokens_per_sec"] / off["tokens_per_sec"], 2
+        )
+
+    log.info(
+        "speculative: %(i)sx tok/s (acceptance %(a)s, %(t)s tok/verify) "
+        "vs verify tick cost %(c).2fx",
+        dict(i=trace_rec.get("tokens_per_sec_improvement", "?"),
+             a=on.get("acceptance_rate", "?"),
+             t=on.get("tokens_per_verify", "?"), c=cost_ratio),
+    )
+    return {
+        "workload": {
+            "model": {
+                "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                "heads": cfg.n_heads, "kv_heads": cfg.n_kv_heads,
+                "vocab": cfg.vocab_size, "dtype": str(cfg.dtype),
+            },
+            "slots": slots,
+            "cache_len": cache_len,
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new,
+            "draft_k": draft_k,
+        },
+        "slope": slope_rec,
+        "trace": trace_rec,
+    }

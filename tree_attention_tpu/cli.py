@@ -480,6 +480,13 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
             f"--impl {cfg.impl} cannot serve a quantized buffer"
         )
+    if cfg.speculate and cfg.temperature != 0.0:
+        raise SystemExit(
+            "--speculate requires greedy decoding: pass --temperature 0 "
+            "(the greedy accept rule is what makes speculation exact)"
+        )
+    if cfg.speculate and not 1 <= cfg.draft_k <= 31:
+        raise SystemExit("--draft-k must be in [1, 31]")
     if not 0.0 <= cfg.prefix_share <= 1.0:
         raise SystemExit("--prefix-share must be in [0, 1]")
     if cfg.prefix_cache and (cfg.prefix_block < 1
@@ -571,6 +578,23 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
                 "--kv-blocks is given (the paged pool is ONE budget)"
             )
         prefix_pool_blocks = None  # no separate retention cap from the CLI
+    drafter = cfg.drafter
+    if cfg.speculate and cfg.drafter == "model":
+        # A shrunk draft transformer (half the layers, same vocab) from
+        # its own seed — the two-model speculative shape, CPU-proxy
+        # sized. Acceptance depends on how well it tracks the big model;
+        # the free 'ngram' drafter is the default for a reason.
+        from tree_attention_tpu.serving.speculation import (
+            DraftModelDrafter,
+        )
+
+        draft_cfg = _dc.replace(
+            tcfg, n_layers=max(tcfg.n_layers // 2, 1)
+        )
+        drafter = DraftModelDrafter(
+            init_params(jax.random.PRNGKey(cfg.seed + 3), draft_cfg),
+            draft_cfg,
+        )
     server = SlotServer(
         params, tcfg,
         slots=cfg.slots, cache_len=cache_len, mesh=mesh,
@@ -588,6 +612,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         kv_layout=cfg.kv_layout,
         kv_block=cfg.kv_block,
         kv_blocks=kv_blocks,
+        speculate=cfg.speculate,
+        draft_k=cfg.draft_k,
+        drafter=drafter,
     )
     from tree_attention_tpu.host_runtime import heartbeat
 
@@ -607,6 +634,8 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         "admission": cfg.admission,
         "prefill_chunk": cfg.prefill_chunk,
         "kv_layout": cfg.kv_layout,
+        **({"speculate": {"draft_k": cfg.draft_k, "drafter": cfg.drafter}}
+           if cfg.speculate else {}),
         **({"prefix_cache": {
             "block": cfg.prefix_block,
             **({"pool_blocks": prefix_pool_blocks}

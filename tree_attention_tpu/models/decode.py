@@ -469,6 +469,8 @@ def forward_step(
     num_splits: Optional[int] = None,
     quant_kernel: str = "q8q",
     n_tokens: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Union[KVCache, QuantKVCache]]:
     """Run ``Tq`` new tokens through the model against the cache.
 
@@ -491,6 +493,23 @@ def forward_step(
         ``0 <= n_tokens[i]`` and ``length[i] + n_tokens[i] <= capacity``;
         ``Tq`` itself must be ``<= capacity`` (the write window is
         ``Tq`` rows).
+      positions: optional per-slot ``(B, Tq)`` TOKEN positions for RoPE —
+        the speculative tree-verification shape (SpecInfer,
+        arXiv:2305.09781), where packed draft-tree node ``j`` of slot
+        ``i`` sits at depth ``depth[j]`` below the committed tip, so its
+        rotary position is ``length[i] + depth[j]``, not ``length[i] +
+        j``. Defaults to ``length[i] + j`` (the linear contract). KV rows
+        still land at buffer positions ``[length[i], length[i] + Tq)`` in
+        ROW order — the tree lives in positions and mask, not in the
+        buffer layout.
+      tree_mask: optional per-slot ``(B, Tq, Tq)`` ancestor-visibility
+        mask (requires ``Tq <= 32``): row ``j`` of slot ``i`` attends its
+        committed history plus exactly the window rows ``tree_mask[i, j]``
+        flags (its draft-tree ancestors and itself), instead of the pure
+        causal window rule. A lower-triangular mask reproduces plain
+        causal masking bit-for-bit. Not supported on the sequence-sharded
+        contiguous tree-decode path (the paged pool is replicated, so
+        paged serving under a mesh takes the flash paths and works).
 
     Returns:
       ``logits``: ``(B, Tq, vocab)`` float32; the updated cache
@@ -549,7 +568,8 @@ def forward_step(
                 f"KV cache overflow: writes reach {hi} tokens, "
                 f"exceeding capacity {cache.capacity}"
             )
-    positions = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)  # (B, Tq)
+    if positions is None:
+        positions = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)
 
     x = jnp.take(params["embed"], tokens, axis=0)
     quant = isinstance(cache, (QuantKVCache, PagedQuantKVCache))
@@ -558,11 +578,51 @@ def forward_step(
             else ("quant" if quant else "exact")
         _STEP_DISPATCH.labels(cache=kind).inc()
 
+    # Satellite fix (ISSUE 8): off the TPU Pallas kernels — the eager/CPU
+    # proxy and interpret-mode runs — a paged step used to re-gather
+    # ``pool[table]`` once PER LAYER inside the scan (flash_decode's
+    # fallback materialises the logical view per call). Hoist that to ONE
+    # gather for the whole step: build the logical (L, B, Hkv, NB·block, D)
+    # views up front, write each layer's new rows into both the pool (the
+    # persistent state) and its view slice (a cheap Tq-row window write),
+    # and run the contiguous attention path on the view. Bit-exact with
+    # the per-layer gather — identical rows in identical order. On TPU the
+    # paged kernels stream blocks in place and this path never runs.
+    hoist_view = False
+    if paged:
+        from tree_attention_tpu.ops import _on_tpu, _pallas_available
+        from tree_attention_tpu.ops.decode import _AUTO_PALLAS
+
+        # Under a >1-way seq mesh the contiguous view would re-route
+        # decode_attention onto the tree-merge branch (the view is
+        # replicated, not seq-sharded) — keep the block-table path there.
+        seq_shards = (
+            max(mesh.shape.get(axes["seq"] or "", 1), 1)
+            if mesh is not None else 1
+        )
+        hoist_view = seq_shards == 1 and not (
+            _AUTO_PALLAS and _on_tpu(params["embed"]) and _pallas_available()
+        )
+    if hoist_view:
+        idx = jnp.clip(cache.table, 0, cache.blocks - 1)  # (B, NB)
+
+        def _view(pool: jax.Array) -> jax.Array:
+            rows = jnp.moveaxis(pool[:, idx], 2, 3)  # (L, B, Hkv, NB, blk, D)
+            L, Bv, Hkv, NB, blk, D = rows.shape
+            return rows.reshape(L, Bv, Hkv, NB * blk, D)
+
+        k_view0, v_view0 = _view(cache.k), _view(cache.v)
+
     def body(x, layer_and_cache):
+        parts = list(layer_and_cache)
+        layer, k_cache, v_cache = parts[:3]
+        parts = parts[3:]
+        k_view = v_view = None
+        if hoist_view:
+            k_view, v_view = parts[:2]
+            parts = parts[2:]
         if quant:
-            layer, k_cache, v_cache, k_s, v_s = layer_and_cache
-        else:
-            layer, k_cache, v_cache = layer_and_cache
+            k_s, v_s = parts
         h = rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = _heads(h @ layer["wq"], cfg.n_heads, cfg.d_head)
         k_new = _heads(h @ layer["wk"], cfg.n_kv_heads, cfg.d_head)
@@ -592,6 +652,17 @@ def forward_step(
             v_cache = _paged_pool_write(
                 v_cache, v_new, cache.table, start, n_valid
             )
+            if hoist_view:
+                # Mirror the new rows into the hoisted logical view (the
+                # pre-scan gather predates this layer's write) — a cheap
+                # Tq-row window write, vs re-gathering the whole pool.
+                wv = jax.vmap(_masked_window_write, in_axes=(0, 0, 0, 0))
+                k_view = wv(
+                    k_view, k_new.astype(k_view.dtype), start, n_valid
+                )
+                v_view = wv(
+                    v_view, v_new.astype(v_view.dtype), start, n_valid
+                )
         elif n_tokens is None:
             write = jax.vmap(
                 lambda buf, rows, s: lax.dynamic_update_slice_in_dim(
@@ -624,17 +695,19 @@ def forward_step(
             seq_axis=axes["seq"],
             model_axis=axes["model"],
             block_size=cfg.attn_block_size,
+            tree_mask=tree_mask,
         )
-        if paged:
+        if paged and not hoist_view:
             attn_kw["block_table"] = cache.table
+        ak, av = (k_view, v_view) if hoist_view else (k_cache, v_cache)
         if quant:
             out, _ = decode_attention(
-                q, k_cache, v_cache, k_scale=k_s, v_scale=v_s,
+                q, ak, av, k_scale=k_s, v_scale=v_s,
                 quant_kernel=quant_kernel, **attn_kw,
             )
         else:
             out, _ = decode_attention(
-                q, k_cache, v_cache,
+                q, ak, av,
                 impl=cfg.attn_impl, num_splits=num_splits, **attn_kw,
             )
         x = x + _unheads(out) @ layer["wo"]
@@ -642,6 +715,8 @@ def forward_step(
         return x, (k_cache, v_cache)
 
     xs = (params["layers"], cache.k, cache.v)
+    if hoist_view:
+        xs = xs + (k_view0, v_view0)
     if quant:
         xs = xs + (cache.k_scale, cache.v_scale)
     x, (new_k, new_v) = lax.scan(body, x, xs)
@@ -758,6 +833,100 @@ def extract_prefix_blocks(
     return grab(cache_k, pool_k), grab(cache_v, pool_v)
 
 
+def _compact_window_slot(
+    buf: jax.Array, start: jax.Array, src: jax.Array, n: jax.Array
+) -> jax.Array:
+    """One slot's piece of :func:`compact_decode_window` (vmapped over
+    batch): ``buf`` is ``(L, Hkv, cap, D)``, ``src`` a ``(W,)`` vector of
+    window-relative source rows, ``start``/``n`` scalars. Token position
+    ``start + i`` takes the value of ``start + src[i]`` for ``i < n``;
+    everything else is written back unchanged (an identity ``src`` with
+    ``n = 0`` is a bit-exact no-op). Same clamp-and-shift trick as
+    :func:`_masked_window_write` near capacity."""
+    W = src.shape[0]
+    cap = buf.shape[2]
+    ws = jnp.clip(start, 0, cap - W)
+    shift = start - ws  # > 0 only when the window straddles capacity
+    window = lax.dynamic_slice_in_dim(buf, ws, W, axis=2)
+    loc = jnp.arange(W, dtype=jnp.int32)
+    rel = loc - shift  # window-relative row this local position holds
+    src_loc = shift + jnp.take(src, jnp.clip(rel, 0, W - 1))
+    idx = jnp.where((rel >= 0) & (rel < n), src_loc, loc)
+    merged = jnp.take(window, jnp.clip(idx, 0, W - 1), axis=2)
+    return lax.dynamic_update_slice_in_dim(buf, merged, ws, axis=2)
+
+
+def compact_decode_window(
+    cache: Union[KVCache, QuantKVCache, PagedKVCache, PagedQuantKVCache],
+    start: jax.Array,
+    src: jax.Array,
+    n: jax.Array,
+) -> Union[KVCache, QuantKVCache, PagedKVCache, PagedQuantKVCache]:
+    """Compact accepted speculative-tree rows to the front of each slot's
+    verify window (the device half of a tree-draft commit).
+
+    A tree verify step writes its packed draft nodes at buffer positions
+    ``[start[i], start[i] + W)`` in ROW order; the accepted root-path's
+    rows are scattered among them. This gathers them contiguous: token
+    position ``start[i] + j`` takes the KV bytes of ``start[i] + src[i,
+    j]`` for ``j < n[i]`` (``src`` is ascending, so sources are never
+    overwritten before being read — and all reads are from the pre-call
+    buffer anyway). Slots with ``n[i] = 0`` are untouched; ``length`` is
+    NOT modified (the engine rolls it back through the next step's
+    ``reset_val``). Linear (chain) drafts never need this — their accepted
+    prefix is already contiguous.
+
+    Works on all four cache layouts: contiguous caches permute inside a
+    window read-modify-write (mesh-safe — the same vmapped machinery as
+    the mixed-Tq write); paged caches gather + re-scatter the few moved
+    rows through the block table (int8 rows move verbatim: they were
+    quantized under their slot's frozen scales, which do not change).
+    """
+    B, W = src.shape
+    src = jnp.asarray(src, jnp.int32)
+    n = jnp.asarray(n, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    if isinstance(cache, (PagedKVCache, PagedQuantKVCache)):
+        table = cache.table
+        N, blk = cache.blocks, cache.block
+        nb = table.shape[1]
+        pos_dst = start[:, None] + jnp.arange(W, dtype=jnp.int32)[None]
+        pos_src = start[:, None] + src
+        valid = (
+            (jnp.arange(W, dtype=jnp.int32)[None] < n[:, None])
+            & (pos_dst < nb * blk)
+            & (pos_src < nb * blk)
+        )
+        pb_src = jnp.clip(
+            jnp.take_along_axis(
+                table, jnp.clip(pos_src // blk, 0, nb - 1), axis=1
+            ), 0, N - 1,
+        )  # gather side clamps; garbage rows pair with dropped dsts
+        pb_dst = jnp.where(
+            valid,
+            jnp.take_along_axis(
+                table, jnp.clip(pos_dst // blk, 0, nb - 1), axis=1
+            ),
+            N,  # OOB -> dropped
+        )
+        sb, so = pb_src.reshape(-1), (pos_src % blk).reshape(-1)
+        db, do = pb_dst.reshape(-1), (pos_dst % blk).reshape(-1)
+
+        def perm(pool: jax.Array) -> jax.Array:
+            rows = pool[:, sb, :, so, :]  # (B·W, L, Hkv, D)
+            return pool.at[:, db, :, do, :].set(
+                rows.astype(pool.dtype), mode="drop"
+            )
+
+        return dataclasses.replace(
+            cache, k=perm(cache.k), v=perm(cache.v)
+        )
+    move = jax.vmap(_compact_window_slot, in_axes=(1, 0, 0, 0), out_axes=1)
+    return dataclasses.replace(
+        cache, k=move(cache.k, start, src, n), v=move(cache.v, start, src, n)
+    )
+
+
 def round_cache_len(
     total: int, mesh: Optional[Mesh] = None, seq_axis: str = AXIS_SEQ
 ) -> int:
@@ -862,6 +1031,7 @@ def decode_attention(
     block_size: Optional[int] = None,
     quant_kernel: str = "q8q",
     block_table: Optional[jax.Array] = None,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Op-level decode entry: split-KV on one device, tree merge on a mesh.
 
@@ -904,11 +1074,12 @@ def decode_attention(
             return kernel_fn(
                 q, k, v, k_scale, v_scale, causal=True,
                 q_offset=q_position, block_size=block_size,
-                block_table=block_table,
+                block_table=block_table, tree_mask=tree_mask,
             )
         return flash_decode(
             q, k, v, q_position=q_position, num_splits=num_splits,
             block_size=block_size, block_table=block_table,
+            tree_mask=tree_mask,
         )
     if q_position is None:
         q_position = k.shape[2] - q.shape[2]
@@ -916,6 +1087,16 @@ def decode_attention(
         mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
     )
     if mesh is not None and mesh.shape.get(ax["seq"] or "", 1) > 1:
+        if tree_mask is not None:
+            # The tree merge has no window-mask plumbing; the serving
+            # engine falls back to chain drafts on this topology (paged
+            # serving replicates its pool and rides the flash paths, so
+            # tree speculation under a mesh wants kv_layout="paged").
+            raise ValueError(
+                "tree_mask is not supported on the sequence-sharded "
+                "tree-decode path; use the paged layout (replicated "
+                "pool, flash kernels) or linear drafts"
+            )
         mesh_kw = dict(
             mesh=mesh,
             seq_axis=ax["seq"],
@@ -943,10 +1124,11 @@ def decode_attention(
         return kernel_fn(
             q, k, v, k_scale, v_scale, causal=True,
             q_offset=q_position, block_size=block_size,
+            tree_mask=tree_mask,
         )
     return flash_decode(
         q, k, v, q_position=q_position, num_splits=num_splits,
-        block_size=block_size,
+        block_size=block_size, tree_mask=tree_mask,
     )
 
 

@@ -113,6 +113,7 @@ def flash_decode(
     num_splits: Optional[int] = None,
     block_size: Optional[int] = None,
     block_table: Optional[jax.Array] = None,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Causal decode attention of a few new queries against a long KV buffer.
 
@@ -152,8 +153,36 @@ def flash_decode(
     prefill-sized Tq on the Q-tiled kernel — the logical view is
     gathered once via :func:`gather_paged_kv` and the contiguous path
     runs unchanged, which keeps eager and Pallas bit-exact.
+
+    ``tree_mask`` (a ``(B, Tq, Tq)`` bool array; requires a ``(B,)``
+    ``q_position`` and ``Tq <= 32``) switches on the speculative
+    tree-verification window rule (SpecInfer, arXiv:2305.09781): the Tq
+    query rows are packed draft-tree nodes occupying KV positions
+    ``[q_position[b], q_position[b] + Tq)`` of their slot, and row ``i``
+    sees a window position ``j`` iff ``tree_mask[b, i, j]`` (its
+    ancestors and itself); everything below the window stays visible,
+    everything past it masked. A lower-triangular mask IS the plain
+    causal rule, bit-for-bit. Supported on the chunked-vmap path and the
+    Pallas decode kernels (as a packed bitmask in SMEM-adjacent VMEM
+    lanes); the Q-tiled prefill kernel never sees spec-sized Tq.
     """
     B, Hq, Tq, D = q.shape
+    if tree_mask is not None:
+        if Tq > 32:
+            raise ValueError(
+                f"tree_mask packs ancestor sets into int32 bitmasks: "
+                f"Tq={Tq} exceeds 32"
+            )
+        if getattr(q_position, "ndim", 0) != 1:
+            raise ValueError(
+                "tree_mask needs a per-slot (B,) q_position (the window "
+                "start is each slot's committed length)"
+            )
+        if tree_mask.shape != (B, Tq, Tq):
+            raise ValueError(
+                f"tree_mask must be (B, Tq, Tq) = {(B, Tq, Tq)}, got "
+                f"{tree_mask.shape}"
+            )
     Tk = (
         block_table.shape[1] * k.shape[2] if block_table is not None
         else k.shape[2]
@@ -185,6 +214,10 @@ def flash_decode(
         )
 
         impl = tpu_kernel_for(Tq)
+        if tree_mask is not None and impl != "pallas_decode":
+            # Spec-tree chunks are <= 32 rows, squarely the decode
+            # kernel's regime; the Q-tiled kernel has no mask path.
+            impl = "pallas_decode"
         if block_table is not None:
             if impl == "pallas_decode":
                 from tree_attention_tpu.ops.pallas_decode import (
@@ -196,7 +229,7 @@ def flash_decode(
                 return attention_pallas_decode(
                     q, k, v, causal=True, scale=scale,
                     q_offset=q_position, kv_offset=0,
-                    block_table=block_table,
+                    block_table=block_table, tree_mask=tree_mask,
                 )
             # Prefill-sized Tq rides the Q-tiled kernel, which has no
             # table path — one gather materialises the logical view
@@ -231,9 +264,12 @@ def flash_decode(
         # Both kernels take scalar OR (B,) offsets (per-batch SMEM
         # columns), so ragged and uniform batches are one dispatch either
         # way.
+        kw = {}
+        if impl == "pallas_decode":
+            kw["tree_mask"] = tree_mask
         return kernel(
             q, k, v, causal=True, scale=scale,
-            q_offset=q_position, kv_offset=0, block_size=bk,
+            q_offset=q_position, kv_offset=0, block_size=bk, **kw,
         )
 
     if block_table is not None:
@@ -261,16 +297,22 @@ def flash_decode(
             # each row masks against its own q_position. Same chunking,
             # same merge — a row's partials are identical to the scalar
             # path's, so ragged and uniform batches agree bit-for-bit.
-            def per_slot(q_b, k_b, v_b, pos_b):
+            # A tree mask rides the same vmap (one (Tq, Tq) ancestor mask
+            # per slot, applied against that slot's window offset).
+            def per_slot(q_b, k_b, v_b, pos_b, *tm_b):
                 o, l = attention_blockwise(
                     q_b[None], k_b[None], v_b[None],
                     causal=True, scale=scale,
                     q_offset=pos_b, kv_offset=off,
                     block_size=min(block_size, chunk),
+                    tree_mask=tm_b[0][None] if tm_b else None,
                 )
                 return o[0], l[0]
 
-            return jax.vmap(per_slot)(q, k_s, v_s, q_position)
+            args = (q, k_s, v_s, q_position)
+            if tree_mask is not None:
+                args = args + (tree_mask,)
+            return jax.vmap(per_slot)(*args)
         return attention_blockwise(
             q, k_s, v_s,
             causal=True, scale=scale,

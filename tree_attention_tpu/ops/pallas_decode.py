@@ -64,7 +64,7 @@ _KERNEL_BUILDS = obs.counter(
 
 
 def _decode_visibility_mask(s, qi, si, *, bq, bk, tq, tk,
-                            q_offset, kv_offset, causal):
+                            q_offset, kv_offset, causal, tree_bits=None):
     """Ragged-tail + causal masking for one (bq, bk) decode score tile —
     the ONE mask definition shared by the bf16-cast and int8-MXU kernels.
 
@@ -75,8 +75,30 @@ def _decode_visibility_mask(s, qi, si, *, bq, bk, tq, tk,
     column positions — one broadcast compare, no full-tile iota
     materialisation (see block_utils.mask_scores for why not a lax.cond
     interior skip). Static no-op for non-causal divisible shapes.
+
+    ``tree_bits`` (a ``(bq, 1)`` int32 tile of per-PACKED-row ancestor
+    bitmasks; requires ``causal`` and ``tq <= 32``) replaces the plain
+    causal rule with the speculative tree-verification window rule
+    (SpecInfer, arXiv:2305.09781): the tq query rows occupy KV positions
+    ``[q_offset, q_offset + tq)`` of their slot, and row ``j`` sees window
+    position ``i`` iff bit ``i`` of its mask is set; positions below the
+    window stay visible (committed history), positions past it never are.
+    A lower-triangular bitmask reproduces causal masking bit-for-bit.
     """
     needs_ragged = tk % bk != 0
+    if tree_bits is not None:
+        col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        rel = kv_offset + col_idx - q_offset  # window-relative KV position
+        # Per-element logical shift; rel >= tq columns fail the window
+        # check regardless, so the clip only keeps the shift in-range.
+        bit = jax.lax.shift_right_logical(
+            jnp.broadcast_to(tree_bits, (bq, bk)),
+            jnp.broadcast_to(jnp.clip(rel, 0, 31), (bq, bk)),
+        ) & 1
+        valid = (rel < 0) | ((rel < tq) & (bit == 1))
+        if needs_ragged:
+            valid &= col_idx < tk
+        return jnp.where(valid, s, NEG_INF)
     if not (causal or needs_ragged):
         return s
     col_idx = si * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
@@ -147,16 +169,19 @@ def _decode_finalize(out_ref, lse_ref, m_scr, l_scr, acc_scr):
 def _flash_decode_kernel(
     offs_ref,  # SMEM (2, B): per-batch [q_offset | kv_offset] columns —
                # ragged caches give every batch row its own global position
-    q_ref,     # VMEM (1, bq, D) — packed (group × Tq) queries of one KV head
-    k_ref,     # VMEM (1, bk, D)
-    v_ref,     # VMEM (1, bk, D)
-    out_ref,   # VMEM (1, bq, D)
-    lse_ref,   # VMEM (1, bq, LANES) — lse broadcast across lanes (host
-               # slices lane 0; TPU tiling wants a 128-multiple trailing dim)
-    m_scr,     # VMEM (bq, LANES) f32 — running max
-    l_scr,     # VMEM (bq, LANES) f32 — running sum
-    acc_scr,   # VMEM (bq, D) f32
-    *,
+    *refs,     # q_ref, [tb_ref when tree], k_ref, v_ref, out_ref, lse_ref,
+               # m_scr, l_scr, acc_scr:
+               #   tb_ref  VMEM (1, bq, LANES) int32 — per-packed-row tree
+               #           ancestor bitmasks (lane-broadcast), tree=True only
+               #   q_ref   VMEM (1, bq, D) — packed (group × Tq) queries of
+               #           one KV head
+               #   k/v_ref VMEM (1, bk, D)
+               #   out_ref VMEM (1, bq, D)
+               #   lse_ref VMEM (1, bq, LANES) — lse broadcast across lanes
+               #           (host slices lane 0; TPU tiling wants a
+               #           128-multiple trailing dim)
+               #   m/l_scr VMEM (bq, LANES) f32 — running max / sum
+               #   acc_scr VMEM (bq, D) f32
     scale: float,
     causal: bool,
     tk: int,
@@ -164,7 +189,14 @@ def _flash_decode_kernel(
     block_q: int,
     block_k: int,
     n_kv_heads: int,
+    tree: bool = False,
 ):
+    if tree:
+        q_ref, tb_ref, k_ref, v_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        tb_ref = None
     qi = pl.program_id(1)
     si = pl.program_id(2)
     n_s = pl.num_programs(2)
@@ -210,6 +242,7 @@ def _flash_decode_kernel(
         s = _decode_visibility_mask(
             s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
             q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+            tree_bits=None if tb_ref is None else tb_ref[0][:, :1],
         )
         _decode_softmax_fold(
             s, v_ref[0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
@@ -222,22 +255,22 @@ def _flash_decode_kernel(
 
 def _flash_decode_q8q_kernel(
     offs_ref,  # SMEM (2, B): per-batch [q_offset | kv_offset] columns
-    q_ref,     # VMEM (1, bq, D) int8 — per-row-quantized, scale-folded Q
-    qs_ref,    # VMEM (1, bq, LANES) f32 — per-row Q scales (lane-broadcast)
-    k_ref,     # VMEM (1, bk, D) int8
-    v_ref,     # VMEM (1, bk, D) int8
-    out_ref,   # VMEM (1, bq, D)
-    lse_ref,   # VMEM (1, bq, LANES)
-    m_scr,     # VMEM (bq, LANES) f32
-    l_scr,     # VMEM (bq, LANES) f32
-    acc_scr,   # VMEM (bq, D) f32
-    *,
+    *refs,     # q_ref, qs_ref, [tb_ref when tree], k_ref, v_ref, out_ref,
+               # lse_ref, m_scr, l_scr, acc_scr:
+               #   tb_ref  VMEM (1, bq, LANES) int32 — tree bitmasks
+               #   q_ref   VMEM (1, bq, D) int8 — per-row-quantized,
+               #           scale-folded Q
+               #   qs_ref  VMEM (1, bq, LANES) f32 — per-row Q scales
+               #   k/v_ref VMEM (1, bk, D) int8
+               #   out_ref VMEM (1, bq, D); lse_ref VMEM (1, bq, LANES)
+               #   m/l_scr VMEM (bq, LANES) f32; acc_scr VMEM (bq, D) f32
     causal: bool,
     tk: int,
     tq: int,
     block_q: int,
     block_k: int,
     n_kv_heads: int,
+    tree: bool = False,
 ):
     """The int8-MXU variant of :func:`_flash_decode_kernel`: scores run
     natively int8 x int8 -> int32 (no K dequant cast on the KV stream — the
@@ -247,6 +280,13 @@ def _flash_decode_q8q_kernel(
     (measurements/r3/experiment_q8q.jsonl). Same online-softmax state and
     ``(out, lse)`` contract; the lse is of the dequantized logits, so the
     output plugs into the tree merge unchanged."""
+    if tree:
+        q_ref, qs_ref, tb_ref, k_ref, v_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, qs_ref, k_ref, v_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+        tb_ref = None
     qi = pl.program_id(1)
     si = pl.program_id(2)
     n_s = pl.num_programs(2)
@@ -280,6 +320,7 @@ def _flash_decode_q8q_kernel(
         s = _decode_visibility_mask(
             s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
             q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+            tree_bits=None if tb_ref is None else tb_ref[0][:, :1],
         )
         _decode_softmax_fold(
             s, v_ref[0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
@@ -295,21 +336,20 @@ def _flash_decode_paged_kernel(
     tbl_ref,   # SMEM (B, NB) scalar-prefetch block table — read by the
                # K/V index maps, not the body: grid step si streams pool
                # block table[b, si] (PagedAttention, arXiv:2309.06180)
-    q_ref,     # VMEM (1, bq, D) — packed (group × Tq) queries of one KV head
-    k_ref,     # VMEM (1, 1, block, D) — pool block tbl[b, si], head h
-    v_ref,     # VMEM (1, 1, block, D)
-    out_ref,   # VMEM (1, bq, D)
-    lse_ref,   # VMEM (1, bq, LANES)
-    m_scr,     # VMEM (bq, LANES) f32
-    l_scr,     # VMEM (bq, LANES) f32
-    acc_scr,   # VMEM (bq, D) f32
-    *,
+    *refs,     # q_ref, [tb_ref when tree], k_ref, v_ref, out_ref, lse_ref,
+               # m_scr, l_scr, acc_scr:
+               #   q_ref   VMEM (1, bq, D) — packed (group × Tq) queries
+               #   tb_ref  VMEM (1, bq, LANES) int32 — tree bitmasks
+               #   k/v_ref VMEM (1, 1, block, D) — pool block tbl[b, si]
+               #   out_ref VMEM (1, bq, D); lse_ref VMEM (1, bq, LANES)
+               #   m/l_scr VMEM (bq, LANES) f32; acc_scr VMEM (bq, D) f32
     scale: float,
     causal: bool,
     tq: int,
     block_q: int,
     block_k: int,
     n_kv_heads: int,
+    tree: bool = False,
 ):
     """Block-table variant of :func:`_flash_decode_kernel`: the split-KV
     grid dimension walks each slot's LOGICAL blocks and the BlockSpec
@@ -322,6 +362,12 @@ def _flash_decode_paged_kernel(
     blocks past the slot's length — a short slot reads only its own few
     blocks of the pool."""
     del tbl_ref  # consumed by the index maps
+    if tree:
+        q_ref, tb_ref, k_ref, v_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        tb_ref = None
     qi = pl.program_id(1)
     si = pl.program_id(2)
     n_s = pl.num_programs(2)
@@ -359,6 +405,7 @@ def _flash_decode_paged_kernel(
         s = _decode_visibility_mask(
             s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
             q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+            tree_bits=None if tb_ref is None else tb_ref[0][:, :1],
         )
         _decode_softmax_fold(
             s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
@@ -372,26 +419,32 @@ def _flash_decode_paged_kernel(
 def _flash_decode_paged_q8q_kernel(
     offs_ref,  # SMEM (2, B) scalar-prefetch
     tbl_ref,   # SMEM (B, NB) scalar-prefetch block table
-    q_ref,     # VMEM (1, bq, D) int8 — per-row-quantized, scale-folded Q
-    qs_ref,    # VMEM (1, bq, LANES) f32 — per-row Q scales
-    k_ref,     # VMEM (1, 1, block, D) int8 — pool block tbl[b, si]
-    v_ref,     # VMEM (1, 1, block, D) int8
-    out_ref,
-    lse_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *refs,     # q_ref, qs_ref, [tb_ref when tree], k_ref, v_ref, out_ref,
+               # lse_ref, m_scr, l_scr, acc_scr:
+               #   q_ref   VMEM (1, bq, D) int8 — per-row-quantized,
+               #           scale-folded Q
+               #   qs_ref  VMEM (1, bq, LANES) f32 — per-row Q scales
+               #   tb_ref  VMEM (1, bq, LANES) int32 — tree bitmasks
+               #   k/v_ref VMEM (1, 1, block, D) int8 — pool block
+               #           tbl[b, si]
     causal: bool,
     tq: int,
     block_q: int,
     block_k: int,
     n_kv_heads: int,
+    tree: bool = False,
 ):
     """Block-table variant of :func:`_flash_decode_q8q_kernel` — same
     int8-MXU score path, KV streamed through the scalar-prefetched
     table (see :func:`_flash_decode_paged_kernel`)."""
     del tbl_ref
+    if tree:
+        q_ref, qs_ref, tb_ref, k_ref, v_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, qs_ref, k_ref, v_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+        tb_ref = None
     qi = pl.program_id(1)
     si = pl.program_id(2)
     n_s = pl.num_programs(2)
@@ -426,6 +479,7 @@ def _flash_decode_paged_q8q_kernel(
         s = _decode_visibility_mask(
             s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
             q_offset=q_offset, kv_offset=kv_offset, causal=causal,
+            tree_bits=None if tb_ref is None else tb_ref[0][:, :1],
         )
         _decode_softmax_fold(
             s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
@@ -511,6 +565,31 @@ def _paged_decode_call(
     )(offs, tbl, *tensors)
 
 
+def _tree_bits_rows(
+    tree_mask: jax.Array, G: int, Hkv: int, bq: int, n_q: int
+) -> jax.Array:
+    """Pack a ``(B, Tq, Tq)`` bool ancestor mask into the per-packed-row
+    bitmask operand the decode kernels read: ``(B*Hkv, n_q*bq, LANES)``
+    int32, bit ``j`` of packed row ``r`` = query row ``r % Tq`` sees window
+    position ``j``. Rows ride VMEM lane-broadcast exactly like the q8q
+    per-row Q scales (the kernel reads ``[:, :1]``). Padded rows get 0 —
+    their window is fully masked (committed history stays visible) and the
+    host slices them away."""
+    B, Tq, _ = tree_mask.shape
+    # One bit per window column; bit 31 wraps to INT32_MIN, which is the
+    # correct bit PATTERN (the kernel shifts logically), and bits never
+    # collide, so the sum is a bitwise OR.
+    bits = jnp.sum(
+        tree_mask.astype(jnp.int32)
+        * jnp.left_shift(1, jnp.arange(Tq, dtype=jnp.int32))[None, None, :],
+        axis=2,
+    )  # (B, Tq)
+    rows = jnp.broadcast_to(bits[:, None, None, :], (B, Hkv, G, Tq))
+    rows = _pad_dim(rows.reshape(B, Hkv, G * Tq), 2, bq)
+    rows = rows.reshape(B * Hkv, n_q * bq, 1)
+    return jnp.broadcast_to(rows, (B * Hkv, n_q * bq, _LANES))
+
+
 def resolve_q8_kernel(kernel: str):
     """The one home of the q8-kernel-name contract: ``"q8q"`` → the int8-MXU
     kernel (:func:`attention_pallas_decode_q8q`), ``"q8"`` → the bf16-cast
@@ -571,6 +650,7 @@ def attention_pallas_decode_q8(
     block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
     block_table: Optional[jax.Array] = None,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode over an int8-quantized KV buffer.
 
@@ -618,7 +698,7 @@ def attention_pallas_decode_q8(
     out, lse = attention_pallas_decode(
         qf, k_q, v_q, causal=causal, scale=scale,
         q_offset=q_offset, kv_offset=kv_offset, block_size=block_size,
-        interpret=interpret, block_table=block_table,
+        interpret=interpret, block_table=block_table, tree_mask=tree_mask,
     )
     # V's per-channel scale applies to the normalised accumulator.
     out = (
@@ -645,6 +725,7 @@ def attention_pallas_decode_q8q(
     block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
     block_table: Optional[jax.Array] = None,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """int8-MXU flash decode over an int8 KV buffer: Q quantized too.
 
@@ -682,6 +763,18 @@ def attention_pallas_decode_q8q(
         )
     G = Hq // Hkv
     sm = (D ** -0.5) if scale is None else scale
+    if tree_mask is not None:
+        if not causal:
+            raise ValueError("tree_mask requires causal=True")
+        if Tq > 32:
+            raise ValueError(
+                f"tree_mask packs into int32 bitmasks: Tq={Tq} exceeds 32"
+            )
+        if tree_mask.shape != (B, Tq, Tq):
+            raise ValueError(
+                f"tree_mask must be (B, Tq, Tq) = {(B, Tq, Tq)}, got "
+                f"{tree_mask.shape}"
+            )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out_dtype = q.dtype
@@ -715,15 +808,24 @@ def attention_pallas_decode_q8q(
         if obs.REGISTRY.enabled:
             _KERNEL_BUILDS.labels(kernel="paged_q8q").inc()
         blk = k_q.shape[2]
+        tensors = [qp, qsp, k_q, v_q]
+        in_specs = [
+            pl.BlockSpec((1, bq, D), _paged_q_map),
+            pl.BlockSpec((1, bq, _LANES), _paged_q_map),
+            pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
+            pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
+        ]
+        if tree_mask is not None:
+            tensors.insert(2, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
+            in_specs.insert(
+                2, pl.BlockSpec((1, bq, _LANES), _paged_q_map)
+            )
         out, lse = _paged_decode_call(
             _flash_decode_paged_q8q_kernel,
             dict(causal=causal, tq=Tq, block_q=bq, block_k=blk,
-                 n_kv_heads=Hkv),
-            [qp, qsp, k_q, v_q],
-            [pl.BlockSpec((1, bq, D), _paged_q_map),
-             pl.BlockSpec((1, bq, _LANES), _paged_q_map),
-             pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
-             pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv))],
+                 n_kv_heads=Hkv, tree=tree_mask is not None),
+            tensors,
+            in_specs,
             q_offset=q_offset, kv_offset=kv_offset,
             block_table=block_table, batch=B, n_q=n_q, bq=bq, d=D,
             out_dtype=jnp.bfloat16, interpret=interpret,
@@ -748,20 +850,28 @@ def attention_pallas_decode_q8q(
 
     if obs.REGISTRY.enabled:
         _KERNEL_BUILDS.labels(kernel="q8q").inc()
+    tensors = [offs, qp, qsp, kp, vp]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+    ]
+    if tree_mask is not None:
+        tensors.insert(3, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
+        in_specs.insert(
+            3,
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
+        )
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_decode_q8q_kernel,
             causal=causal, tk=Tk, tq=Tq, block_q=bq, block_k=bk,
-            n_kv_heads=Hkv,
+            n_kv_heads=Hkv, tree=tree_mask is not None,
         ),
         grid=(B * Hkv, n_q, n_s),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
@@ -779,7 +889,7 @@ def attention_pallas_decode_q8q(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(offs, qp, qsp, kp, vp)
+    )(*tensors)
 
     out = out[:, :r]
     # V's per-channel scale on the normalised accumulator, like the q8 path.
@@ -806,6 +916,7 @@ def attention_pallas_decode(
     block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
     block_table: Optional[jax.Array] = None,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode. Same ``(out, lse)`` contract as the other impls.
 
@@ -831,8 +942,26 @@ def attention_pallas_decode(
     but still dereferenced (the engine keeps them at 0). Bit-exact with
     gathering ``pool[table]`` into a contiguous buffer and calling the
     unpaged kernel — the tiles stream identical rows in identical order.
+
+    ``tree_mask`` (a ``(B, Tq, Tq)`` bool array; requires ``causal`` and
+    ``Tq <= 32``) switches on the speculative tree-verification window
+    rule (see :func:`_decode_visibility_mask`): it is packed into int32
+    per-row bitmasks that ride a lane-broadcast VMEM operand, exactly
+    like the q8q per-row Q scales.
     """
     B, Hq, Tq, D = q.shape
+    if tree_mask is not None:
+        if not causal:
+            raise ValueError("tree_mask requires causal=True")
+        if Tq > 32:
+            raise ValueError(
+                f"tree_mask packs into int32 bitmasks: Tq={Tq} exceeds 32"
+            )
+        if tree_mask.shape != (B, Tq, Tq):
+            raise ValueError(
+                f"tree_mask must be (B, Tq, Tq) = {(B, Tq, Tq)}, got "
+                f"{tree_mask.shape}"
+            )
     if block_table is not None:
         Hkv, Tk = k.shape[1], block_table.shape[1] * k.shape[2]
     else:
@@ -873,14 +1002,24 @@ def attention_pallas_decode(
             _KERNEL_BUILDS.labels(
                 kernel="paged_q8" if k.dtype == jnp.int8 else "paged"
             ).inc()
+        tensors = [qp, k, v]
+        in_specs = [
+            pl.BlockSpec((1, bq, D), _paged_q_map),
+            pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv)),
+            pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv)),
+        ]
+        if tree_mask is not None:
+            tensors.insert(1, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
+            in_specs.insert(
+                1, pl.BlockSpec((1, bq, _LANES), _paged_q_map)
+            )
         out, lse = _paged_decode_call(
             _flash_decode_paged_kernel,
             dict(scale=s, causal=causal, tq=Tq, block_q=bq,
-                 block_k=k.shape[2], n_kv_heads=Hkv),
-            [qp, k, v],
-            [pl.BlockSpec((1, bq, D), _paged_q_map),
-             pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv)),
-             pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv))],
+                 block_k=k.shape[2], n_kv_heads=Hkv,
+                 tree=tree_mask is not None),
+            tensors,
+            in_specs,
             q_offset=q_offset, kv_offset=kv_offset,
             block_table=block_table, batch=B, n_q=n_q, bq=bq, d=D,
             out_dtype=q.dtype, interpret=interpret,
@@ -918,19 +1057,27 @@ def attention_pallas_decode(
         _KERNEL_BUILDS.labels(
             kernel="q8" if k.dtype == jnp.int8 else "exact"
         ).inc()
+    tensors = [offs, qp, kp, vp]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
+    ]
+    if tree_mask is not None:
+        tensors.insert(2, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
+        in_specs.insert(
+            2,
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
+        )
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_decode_kernel,
             scale=s, causal=causal, tk=Tk, tq=Tq, block_q=bq, block_k=bk,
-            n_kv_heads=Hkv,
+            n_kv_heads=Hkv, tree=tree_mask is not None,
         ),
         grid=(B * Hkv, n_q, n_s),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, qi, si: (bh, si, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi, si: (bh, qi, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, si: (bh, qi, 0)),
@@ -950,7 +1097,7 @@ def attention_pallas_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(offs, qp, kp, vp)
+    )(*tensors)
 
     out = out[:, :r].reshape(B, Hq, Tq, D).astype(out_dtype)
     lse = lse[:, :r, 0].reshape(B, Hq, Tq)

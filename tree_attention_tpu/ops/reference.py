@@ -90,6 +90,7 @@ def attention_naive(
     scale: Optional[float] = None,
     q_offset=0,
     kv_offset=0,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Materialised-scores attention. Oracle implementation for tests.
 
@@ -123,7 +124,23 @@ def attention_naive(
         "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32,
         precision=matmul_precision(qg.dtype, k.dtype),
     ) * s
-    if causal:
+    if tree_mask is not None:
+        # Tree-window rule (see attention_blockwise): visible below the
+        # window, per the packed ancestor mask inside it, never past it.
+        if not causal:
+            raise ValueError("tree_mask requires causal=True")
+        rel = (
+            kv_offset + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+            - q_offset
+        )
+        taken = jnp.take_along_axis(
+            tree_mask,
+            jnp.broadcast_to(jnp.clip(rel, 0, Tq - 1)[None], (B, Tq, Tk)),
+            axis=2,
+        )
+        mask = (rel < 0)[None] | ((rel < Tq)[None] & taken)
+        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    elif causal:
         mask = _causal_mask(Tq, Tk, q_offset, kv_offset)
         logits = jnp.where(mask[None, None, None], logits, NEG_INF)
 
@@ -159,6 +176,7 @@ def attention_blockwise(
     q_offset=0,
     kv_offset=0,
     block_size: int = 512,
+    tree_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Online-softmax attention: ``lax.scan`` over KV blocks, O(block) memory.
 
@@ -170,6 +188,16 @@ def attention_blockwise(
     GQA runs against *unexpanded* KV: query heads are folded into a group axis
     (``bghqd,bhkd->bghqk``) so KV memory stays ``Hkv``-sized — the point of
     grouped-query attention for big KV caches.
+
+    ``tree_mask`` (a ``(B, Tq, Tq)`` bool array, requires ``causal=True``
+    and a scalar ``q_offset``) switches the **window rule** of speculative
+    tree verification (SpecInfer, arXiv:2305.09781) on: the Tq query rows
+    are packed draft-tree nodes occupying KV positions ``[q_offset,
+    q_offset + Tq)``, and query row ``i`` sees KV position ``p`` iff
+    ``p < q_offset`` (the committed history) or ``p`` lies in the window
+    with ``tree_mask[b, i, p - q_offset]`` set (an ancestor of ``i`` — or
+    ``i`` itself). A lower-triangular mask reproduces plain causal
+    masking bit-for-bit (same visibility sets, same arithmetic).
     """
     B, Hq, Tq, D = q.shape
     Hkv = k.shape[1]
@@ -180,6 +208,14 @@ def attention_blockwise(
     G = Hq // Hkv
     Tk = k.shape[2]
     s = _default_scale(D, scale)
+    if tree_mask is not None:
+        if not causal:
+            raise ValueError("tree_mask requires causal=True")
+        if tree_mask.shape != (B, Tq, Tq):
+            raise ValueError(
+                f"tree_mask must be (B, Tq, Tq) = {(B, Tq, Tq)}, got "
+                f"{tree_mask.shape}"
+            )
 
     if Tk == 0:  # empty shard contributes the safe-softmax identity
         return (
@@ -204,8 +240,31 @@ def attention_blockwise(
             preferred_element_type=jnp.float32,
             precision=matmul_precision(jnp.float32),
         )
-        valid = tile_mask(Tq, blk, blk_idx, Tk, q_offset, kv_offset, causal)
-        logits = jnp.where(valid[None, None, None], logits, NEG_INF)
+        if tree_mask is None:
+            valid = tile_mask(Tq, blk, blk_idx, Tk, q_offset, kv_offset,
+                              causal)
+            logits = jnp.where(valid[None, None, None], logits, NEG_INF)
+        else:
+            # Tree-window rule: below the window everything is visible,
+            # inside it the packed ancestor mask decides, past it nothing
+            # is (the plain causal rule is the lower-triangular special
+            # case). ``rel`` is the KV position relative to the window
+            # start q_offset.
+            col = blk_idx * blk + lax.broadcasted_iota(
+                jnp.int32, (Tq, blk), 1
+            )
+            rel = kv_offset + col - q_offset  # (Tq, blk)
+            taken = jnp.take_along_axis(
+                tree_mask,
+                jnp.broadcast_to(
+                    jnp.clip(rel, 0, Tq - 1)[None], (B, Tq, blk)
+                ),
+                axis=2,
+            )
+            valid = (col < Tk)[None] & (
+                (rel < 0)[None] | ((rel < Tq)[None] & taken)
+            )
+            logits = jnp.where(valid[:, None, None], logits, NEG_INF)
 
         m_blk = jnp.max(logits, axis=-1)
         m_new = jnp.maximum(m_prev, m_blk)

@@ -21,3 +21,13 @@ from tree_attention_tpu.serving.prefix_cache import (  # noqa: F401
     PagedPrefixIndex,
     PrefixCache,
 )
+from tree_attention_tpu.serving.speculation import (  # noqa: F401
+    DraftModelDrafter,
+    DraftProposal,
+    Drafter,
+    PromptLookupDrafter,
+    PromptLookupTreeDrafter,
+    accept_longest_path,
+    make_drafter,
+    pack_proposal,
+)

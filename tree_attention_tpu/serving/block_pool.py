@@ -174,6 +174,20 @@ class BlockAllocator:
         self._free.append(bid)
         self.gen += 1
 
+    def unmap_private(self, bid: int) -> None:
+        """A slot unmaps a block whose tokens were ROLLED BACK (rejected
+        speculation) but keeps its worst-case claim: the block returns to
+        the free list AND the reservation it consumed is restored, so the
+        slot's later re-allocation cannot fail. Net availability is
+        unchanged (+1 free, +1 reserved), hence no generation bump — a
+        deferred admission could not be admitted by this."""
+        assert self._state[bid] == _PRIVATE, (
+            f"block {bid} unmapped while not privately owned"
+        )
+        self._state[bid] = _FREE
+        self._free.append(bid)
+        self.reserved += 1
+
     def free_cached(self, bid: int) -> None:
         """The radix tree evicts a refcount-0 leaf's block."""
         assert self._state[bid] == _CACHED, (

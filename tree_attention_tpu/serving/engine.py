@@ -76,6 +76,23 @@ Variants:
   quantize-after-prefill contract). ``kv_layout="contiguous"`` keeps the
   PR-5 layout.
 
+- ``speculate=True`` (ISSUE 8) turns every live slot's tick into a
+  **draft-and-verify** step (speculative decoding, arXiv:2211.17192): a
+  host drafter proposes up to ``draft_k`` candidate tokens from the
+  slot's own history (prompt-lookup n-grams by default — zero extra
+  model; a token *tree* with ``drafter="ngram-tree"``, verified under
+  the tree-attention ancestor mask — SpecInfer, arXiv:2305.09781; or a
+  small draft model), the ONE compiled mixed-Tq step scores all of them
+  as a prefill-style chunk, the longest accepted root path commits in a
+  burst (plus the model's free bonus token at the divergence), and
+  rejections roll the slot's length back through the next step's
+  ``reset_val`` — with paged blocks past the rollback point unmapped
+  back into the slot's reservation so rolled-back KV never leaks pool
+  capacity. Greedy only: committed tokens are token-for-token identical
+  to non-speculative decode, the hard parity contract
+  (``tests/test_serving_spec.py`` pins it across exact/int8 ×
+  chunked/whole × device/mesh).
+
 Works on one device and on a sequence-sharded mesh (the contiguous cache
 is seq-sharded and rides the tree merge; the paged pool is replicated —
 block offsets cannot stay aligned with a sequence shard — and rides the
@@ -105,6 +122,7 @@ from tree_attention_tpu.models.decode import (
     PagedQuantKVCache,
     QuantKVCache,
     _sample,
+    compact_decode_window,
     forward_step,
     init_cache,
     init_paged_cache,
@@ -112,6 +130,14 @@ from tree_attention_tpu.models.decode import (
     quantize_cache,
 )
 from tree_attention_tpu.serving.block_pool import BlockAllocator
+from tree_attention_tpu.serving.speculation import (
+    Drafter,
+    DraftProposal,
+    PackedSpec,
+    accept_longest_path,
+    make_drafter,
+    pack_proposal,
+)
 from tree_attention_tpu.models.transformer import Params, TransformerConfig
 from tree_attention_tpu.utils.logging import get_logger
 
@@ -150,6 +176,20 @@ _TBT = obs.histogram(
     "serving_tbt_seconds",
     "wall seconds between consecutive tokens of one live slot "
     "(inter-token latency)",
+)
+_SPEC_PROPOSED = obs.counter(
+    "serving_spec_proposed_total",
+    "draft tokens proposed into speculative verify ticks",
+)
+_SPEC_ACCEPTED = obs.counter(
+    "serving_spec_accepted_total",
+    "proposed draft tokens the verify pass accepted (bonus tokens — the "
+    "model's own next token at the divergence point — are not drafts and "
+    "do not count)",
+)
+_SPEC_ACCEPT_RATIO = obs.gauge(
+    "serving_spec_acceptance_ratio",
+    "lifetime accepted/proposed draft-token ratio (set per verify tick)",
 )
 
 
@@ -201,6 +241,9 @@ class ServeReport:
     # Paged-pool accounting (block occupancy at run end + peak); empty
     # under the contiguous layout.
     kv: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Speculative-decoding accounting for THIS run (proposed/accepted
+    # draft tokens, acceptance_rate, verify ticks); empty when off.
+    spec: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -238,6 +281,7 @@ class ServeReport:
             **({"slo": self.slo} if self.slo else {}),
             **({"prefix": self.prefix} if self.prefix else {}),
             **({"kv": self.kv} if self.kv else {}),
+            **({"spec": self.spec} if self.spec else {}),
         }
 
 
@@ -383,6 +427,26 @@ class SlotServer:
         admissions whose worst case cannot be reserved wait in the
         queue, and a request that could never fit fails validation with
         a clear message.
+      speculate: draft-and-verify speculative decoding (arXiv:2211.17192)
+        on the mixed-Tq tick. Every live slot's tick becomes a verify
+        chunk: a host drafter proposes up to ``draft_k`` tokens, the ONE
+        compiled step scores them all (prefill-style), the longest
+        accepted path commits at once and rejections roll the slot's
+        device length back (paged blocks past the rollback unmap without
+        leaking pool capacity). Greedy only (``temperature`` must be 0 —
+        the accept rule is exact there): committed tokens are
+        token-for-token identical to non-speculative decode.
+      draft_k: max draft tokens per slot per verify tick (1..31 — the
+        tree mask packs into int32 bitmasks). One verify commits between
+        1 and ``draft_k + 1`` tokens.
+      drafter: ``"ngram"`` (default — prompt-lookup over the slot's own
+        history, zero extra model), ``"ngram-tree"`` (multi-branch token
+        trees verified under the tree-attention mask, SpecInfer
+        arXiv:2305.09781), or any :class:`~tree_attention_tpu.serving
+        .speculation.Drafter` instance (e.g. ``DraftModelDrafter``).
+        Tree proposals fall back to their root-path chain on the one
+        topology without mask plumbing (contiguous layout on a >1-way
+        seq mesh).
     """
 
     def __init__(
@@ -409,6 +473,9 @@ class SlotServer:
         kv_layout: str = "paged",
         kv_block: Optional[int] = None,
         kv_blocks: Optional[int] = None,
+        speculate: bool = False,
+        draft_k: int = 4,
+        drafter: Union[str, Drafter, None] = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -439,6 +506,22 @@ class SlotServer:
         self.temperature = float(temperature)
         if self.temperature < 0:
             raise ValueError("temperature must be >= 0 (0 = greedy)")
+        self._speculate = bool(speculate)
+        if self._speculate:
+            if self.temperature != 0.0:
+                # The greedy accept rule is exact; sampled acceptance
+                # (rejection sampling over distributions) is a different
+                # contract this engine does not implement.
+                raise ValueError(
+                    "speculate=True requires greedy decoding "
+                    "(temperature=0)"
+                )
+            if not 1 <= draft_k <= 31:
+                raise ValueError(
+                    f"draft_k must be in [1, 31] (int32 tree bitmasks), "
+                    f"got {draft_k}"
+                )
+        self.draft_k = draft_k
         self.admission = admission
         self.prefill_chunk = min(prefill_chunk, cache_len)
         self.prefill_budget = (
@@ -635,6 +718,48 @@ class SlotServer:
                 self._whole_suffix_fn, donate_argnums=(7,)
             )
 
+        # Speculative decoding (ISSUE 8): the host drafter, the per-slot
+        # committed-length ledger (the rollback truth — the device length
+        # over-counts by the rejected rows until the next step's reset),
+        # and the verify-step programs. The tree program only exists where
+        # the mask is plumbed; the one unplumbed topology (contiguous
+        # cache on a >1-way seq mesh rides the tree merge) falls back to
+        # root-path chains, which are exactly causal.
+        self._drafter: Optional[Drafter] = None
+        self._tree_ok = not (kv_layout == "contiguous"
+                             and self._seq_shards > 1)
+        # Verify chunks ride power-of-two Tq buckets like prefill chunks;
+        # the bucket must fit the cache's write window, so the draft size
+        # clamps to the largest power of two <= min(32, cache_len).
+        cap = 1
+        while cap * 2 <= min(32, cache_len):
+            cap *= 2
+        self._spec_rows_cap = cap
+        self._slot_clen = [0] * slots
+        # Per-slot token history for the drafter, filled INCREMENTALLY
+        # (admit writes the prompt, every commit appends its burst) — a
+        # per-tick concatenate of prompt + emitted would make host-side
+        # drafting O(n^2) over a generation. history = buf[i, :len].
+        self._hist_buf = np.zeros((slots, cache_len + 1), np.int32)
+        self._hist_len = [0] * slots
+        self._spec_proposed = 0   # lifetime draft tokens proposed
+        self._spec_accepted = 0   # lifetime draft tokens accepted
+        self._spec_ticks = 0      # ticks that verified >= 1 draft token
+        self._spec_verifies = 0   # per-SLOT verify events with >= 1 draft
+        self._tick_spec: Tuple[int, int, int] = (0, 0, 0)
+        if self._speculate:
+            self._drafter = (
+                make_drafter(drafter or "ngram")
+                if isinstance(drafter, str) or drafter is None else drafter
+            )
+            self._spec_lin = jax.jit(
+                self._spec_lin_fn, donate_argnums=(8,)
+            )
+            self._spec_tree = jax.jit(
+                self._spec_tree_fn, donate_argnums=(10,)
+            )
+            self._compact = jax.jit(self._compact_fn, donate_argnums=(0,))
+
     # -- compiled pieces --------------------------------------------------
 
     def _sample(self, logits: jax.Array, key: jax.Array) -> jax.Array:
@@ -708,6 +833,74 @@ class SlotServer:
         reset_val = jnp.where(one_hot, start, 0).astype(jnp.int32)
         return self._mixed_fn(params, tokens, n_vec, reset, reset_val,
                               emit, cache, key)
+
+    def _spec_step(self, params, mat, tok_vec, use_dev0, n_tok, reset,
+                   reset_val, emit, depth, bits, cache, key):
+        """THE verify-tick program (speculate=True): the same mixed-Tq
+        step as :meth:`_mixed_fn` plus the three speculative extras —
+
+        - row 0 of each slot comes from the DEVICE token vector when
+          ``use_dev0`` (a whole-admission ``await`` slot's parked first
+          token only exists there); every other row from the host-built
+          matrix (spec mode is greedy, so the host knows every committed
+          token);
+        - ``depth``/``bits`` (tree ticks only): packed draft-tree nodes
+          take RoPE position ``length + depth[row]`` and attend under the
+          per-slot ancestor mask instead of row-order causal — chain
+          slots ride ``arange``/lower-triangular defaults, which are the
+          causal rule bit-for-bit;
+        - a second output: the greedy argmax of EVERY row — the accept
+          walk's input (the model's next token after each draft node).
+
+        ``reset_val`` doubles as the rollback: a spec slot always resets
+        to its host-side committed length, which un-counts the rows a
+        previous tick's verify rejected.
+        """
+        tokens = mat.at[:, 0].set(jnp.where(use_dev0, tok_vec, mat[:, 0]))
+        length = jnp.where(reset, reset_val, cache.length)
+        cache = dataclasses.replace(cache, length=length)
+        kw = dict(self._fs_kw)
+        if self.quantize:
+            kw["quant_kernel"] = self.quant_kernel
+        if depth is not None:
+            kw["positions"] = length[:, None] + depth
+            kw["tree_mask"] = bits
+        logits, new_cache = forward_step(
+            params, tokens, cache, self.cfg, n_tokens=n_tok, **kw
+        )
+        key, sub = jax.random.split(key)
+        idx = jnp.maximum(n_tok - 1, 0)
+        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        nxt = self._sample(last, sub)
+        nxt = jnp.where(emit, nxt, tokens[:, 0])
+        all_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, Tq)
+        # One fused (S, 1+Tq) output = ONE host fetch per tick: column 0
+        # is the token vector (the awaits/parked contract), the rest the
+        # verify argmax rows.
+        return jnp.concatenate([nxt[:, None], all_tok], axis=1), \
+            new_cache, key
+
+    def _spec_lin_fn(self, params, mat, tok_vec, use_dev0, n_tok, reset,
+                     reset_val, emit, cache, key):
+        """Verify tick with chain drafts only — pure causal, no mask or
+        position operands (one program family shared with chunk ticks)."""
+        return self._spec_step(params, mat, tok_vec, use_dev0, n_tok,
+                               reset, reset_val, emit, None, None, cache,
+                               key)
+
+    def _spec_tree_fn(self, params, mat, tok_vec, use_dev0, n_tok, reset,
+                      reset_val, emit, depth, bits, cache, key):
+        """Verify tick with >= 1 token-tree draft: per-slot depths and
+        ancestor masks ride along (SpecInfer, arXiv:2305.09781)."""
+        return self._spec_step(params, mat, tok_vec, use_dev0, n_tok,
+                               reset, reset_val, emit, depth, bits, cache,
+                               key)
+
+    def _compact_fn(self, cache, start, src, n):
+        """Batched commit compaction: move each verifying slot's accepted
+        tree rows contiguous (see models.decode.compact_decode_window);
+        slots with n=0 are bit-identically untouched."""
+        return compact_decode_window(cache, start, src, n)
 
     def _prefill_fn(self, params, prompt, plen, key):
         """Legacy whole-prompt admission: prefill one request into a fresh
@@ -957,6 +1150,10 @@ class SlotServer:
         # Prefix reuse happens FIRST: the matched length decides how much
         # prompt is left to prefill (and rides the request span below).
         self._prompt_np[slot] = np.asarray(req.prompt, np.int32)
+        if self._speculate:
+            plen = len(self._prompt_np[slot])
+            self._hist_buf[slot, :plen] = self._prompt_np[slot]
+            self._hist_len[slot] = plen
         if self._paged:
             # The reservation was taken (and the radix path pinned) by
             # _paged_reserve in the admit loop — here the slot takes
@@ -1199,6 +1396,192 @@ class SlotServer:
             plan.append((slot, n, pos + n == plen))
         return plan
 
+    # -- speculation (ISSUE 8) --------------------------------------------
+
+    def _spec_bucket(self, n: int) -> int:
+        """Tq bucket for a verify tick: power-of-two, floor 8 (shared with
+        the chunk buckets so mixtures reuse programs), capped at the
+        cache-window-safe rows cap."""
+        b = min(8, self._spec_rows_cap)
+        while b < n:
+            b *= 2
+        return min(b, self._spec_rows_cap)
+
+    def _draft_slot(self, i: int, tree_ok: bool = True) -> PackedSpec:
+        """Build slot ``i``'s verify chunk for this tick: ask the drafter
+        for up to the clamped budget of candidates (never past the
+        request's remaining token budget — the satellite contract: a
+        drafter proposing past ``max_new_tokens`` is truncated here, not
+        trusted), fall back to the root-path chain where the tree mask
+        cannot run (the seq-sharded contiguous topology, or a tick whose
+        prefill chunks widen Tq past the int32 bitmask — ``tree_ok``),
+        and pack with the committed tip as row 0. A ``None`` or empty
+        proposal packs to one row — a plain decode tick."""
+        req = self._slot_req[i]
+        tip = self._slot_tokens[i][-1]
+        remaining = req.max_new_tokens - len(self._slot_tokens[i])
+        budget = min(self.draft_k, remaining - 1, self._spec_rows_cap - 1)
+        prop: Optional[DraftProposal] = None
+        if budget >= 1:
+            hist = self._hist_buf[i, :self._hist_len[i]]  # view, no copy
+            prop = self._drafter.propose(hist, budget)
+        if prop is not None and len(prop) > 0:
+            prop = prop.truncated(budget)
+            if (not self._tree_ok or not tree_ok) and not prop.is_chain:
+                prop = prop.chain_prefix()
+        else:
+            prop = DraftProposal(
+                tokens=np.empty((0,), np.int32),
+                parents=np.empty((0,), np.int32),
+            )
+        return pack_proposal(tip, prop)
+
+    def _spec_unmap(self, slot: int) -> None:
+        """Roll back the slot's block tail after a partial accept: blocks
+        wholly past the committed coverage were only ever written with
+        rejected rows — unmap them into the slot's reservation
+        (free + re-reserved, so the later re-allocation cannot fail and
+        rolled-back KV never leaks pool capacity). Runs AFTER the commit
+        compaction dispatched (the device still maps the blocks for that
+        gather; nothing allocates until the next tick's admissions)."""
+        keep = -(-self._slot_clen[slot] // self.kv_block)
+        while self._slot_nblocks[slot] > keep:
+            j = self._slot_nblocks[slot] - 1
+            bid = int(self._host_table[slot, j])
+            if bid not in self._slot_private[slot]:
+                # Shared (prefix) blocks never sit past the committed
+                # tail; defensive stop if one ever did.
+                break
+            self._pool.unmap_private(bid)
+            self._slot_private[slot].discard(bid)
+            self._slot_reserve[slot] += 1
+            self._host_table[slot, j] = 0
+            self._slot_nblocks[slot] -= 1
+            self._table_dirty = True
+
+    def _spec_commit_all(
+        self,
+        spec_plan: Dict[int, PackedSpec],
+        alltok: np.ndarray,
+        width: int,
+        now: float,
+        tick: int,
+        results: List[RequestResult],
+        tbt: List[float],
+    ) -> int:
+        """The host half of a verify tick: walk each slot's fetched
+        per-row argmaxes, emit the committed burst (EOS/budget checks in
+        stream order — an EOS inside the burst truncates it, same tick),
+        update the committed-length ledger (the next step's reset performs
+        the device rollback), batch the tree compactions into ONE
+        dispatch, and unmap rolled-back paged blocks. Returns the number
+        of tokens emitted."""
+        emitted_total = 0
+        compact_src: Optional[np.ndarray] = None
+        compact_n: Optional[np.ndarray] = None
+        compact_start: Optional[np.ndarray] = None
+        t_slots = t_prop = t_acc = 0
+        t_ver = 0
+        for i, pack in spec_plan.items():
+            req = self._slot_req[i]
+            kept, committed = accept_longest_path(pack, alltok[i])
+            m = pack.rows - 1
+            t_slots += 1
+            t_prop += m
+            t_acc += len(kept)
+            if m:
+                t_ver += 1
+            # Truncate the burst at the request budget and at EOS — the
+            # drafter was already clamped to the budget, but the contract
+            # is enforced here, where it matters.
+            remaining = req.max_new_tokens - len(self._slot_tokens[i])
+            emit_list = committed[:remaining]
+            outcome = None
+            if req.eos_id is not None:
+                for j, t in enumerate(emit_list):
+                    if t == req.eos_id:
+                        emit_list = emit_list[:j + 1]
+                        outcome = "eos"
+                        break
+            n_emit = len(emit_list)
+            if outcome is None and (
+                len(self._slot_tokens[i]) + n_emit >= req.max_new_tokens
+            ):
+                outcome = "max_tokens"
+            # The burst lands at one instant: the first token carries the
+            # whole inter-token gap, the rest arrive for free — the
+            # honest latency shape of speculative decode.
+            gap = max(now - self._last_tok_t[i], 0.0)
+            self._last_tok_t[i] = now
+            if gap > self._slot_max_tbt[i]:
+                self._slot_max_tbt[i] = gap
+            self.slo.observe_tbt(gap)
+            hl = self._hist_len[i]
+            for j, t in enumerate(emit_list):
+                self._slot_tokens[i].append(int(t))
+                self._hist_buf[i, hl + j] = int(t)
+                tbt.append(gap if j == 0 else 0.0)
+                if obs.REGISTRY.enabled:
+                    _TOKENS.inc()
+                    _TBT.observe(gap if j == 0 else 0.0)
+            self._hist_len[i] = hl + n_emit
+            emitted_total += n_emit
+            if obs.REGISTRY.enabled and m:
+                _SPEC_PROPOSED.inc(m)
+                if kept:
+                    _SPEC_ACCEPTED.inc(len(kept))
+            if obs.TRACER.active:
+                obs.instant("spec_verify", cat="serving", args={
+                    "rid": req.uid, "slot": i, "tick": tick,
+                    "proposed": m, "accepted": len(kept),
+                    "committed": n_emit,
+                })
+            if outcome is not None:
+                self._retire(i, tick, outcome, results)
+                continue
+            # Committed cache rows: the tip's (row 0) plus every accepted
+            # draft row; the bonus token is the new pending tip.
+            a = len(kept)
+            old_clen = self._slot_clen[i]
+            if kept != list(range(1, a + 1)):
+                # A non-chain accepted path: its KV rows sit scattered in
+                # the window — batch the gather-to-front for ONE compact
+                # dispatch after the loop.
+                if compact_src is None:
+                    compact_src = np.tile(
+                        np.arange(width, dtype=np.int32), (self.slots, 1)
+                    )
+                    compact_n = np.zeros((self.slots,), np.int32)
+                    compact_start = np.zeros((self.slots,), np.int32)
+                compact_src[i, 1:a + 1] = kept
+                compact_n[i] = a + 1
+                compact_start[i] = old_clen
+            self._slot_clen[i] = old_clen + 1 + a
+        if compact_src is not None:
+            # ONE batched gather-to-front for every tree commit of the
+            # tick (the device table still maps the rolled-back blocks —
+            # unmapping below is host bookkeeping that only reaches the
+            # device at the next tick's sync, after this gather ran).
+            self.cache = self._compact(
+                self.cache, jnp.asarray(compact_start),
+                jnp.asarray(compact_src), jnp.asarray(compact_n),
+            )
+        if self._paged:
+            for i in spec_plan:
+                if self._slot_state[i] == "live":  # retired slots freed
+                    self._spec_unmap(i)
+        self._spec_proposed += t_prop
+        self._spec_accepted += t_acc
+        self._spec_verifies += t_ver
+        if t_prop:
+            self._spec_ticks += 1
+        if obs.REGISTRY.enabled and self._spec_proposed:
+            _SPEC_ACCEPT_RATIO.set(
+                self._spec_accepted / self._spec_proposed
+            )
+        self._tick_spec = (t_slots, t_prop, t_acc)
+        return emitted_total
+
     def _consume_chunk(self, slot: int, n: int,
                        last: bool) -> Tuple[np.ndarray, bool]:
         """Host-side bookkeeping of one scheduled chunk — the ONE copy the
@@ -1343,6 +1726,8 @@ class SlotServer:
         tokens = 0
         prefix0 = self._prefix.stats() if self._prefix is not None else None
         hit_bytes0 = self._hit_bytes_moved
+        spec0 = (self._spec_proposed, self._spec_accepted,
+                 self._spec_ticks, self._spec_verifies)
         if self._paged:
             self._peak_blocks_used = self._pool.used
             self._defer_gen = -1  # stale latch must not defer a fresh run
@@ -1358,6 +1743,7 @@ class SlotServer:
                 now = time.monotonic()
                 self._tick_prefix_hits = 0
                 self._tick_prefix_reused = 0
+                self._tick_spec = (0, 0, 0)
                 visible = 0
                 for r in pending:  # sorted by arrival_tick — stop at future
                     if r.arrival_tick > tick:
@@ -1437,7 +1823,127 @@ class SlotServer:
                         ran_staged = True
 
                     stepped = False
-                    if plan:
+                    spec_plan: Dict[int, PackedSpec] = {}
+                    all_tok_dev = None
+                    spec_width = 0
+                    if self._speculate and live_idx:
+                        # Draft-and-verify (ISSUE 8): every live slot's
+                        # tick becomes a verify chunk — tip token at row
+                        # 0, up to draft_k candidates behind it (m = 0 is
+                        # a plain decode row). Drafting is pure host work.
+                        # A tick whose prefill chunks widen Tq past 32
+                        # cannot run the int32 tree bitmasks — trees fall
+                        # back to their root-path chains for that tick.
+                        chunk_tq = (
+                            self._chunk_bucket(max(n for _, n, _ in plan))
+                            if plan else 1
+                        )
+                        for i in live_idx:
+                            spec_plan[i] = self._draft_slot(
+                                i, tree_ok=chunk_tq <= 32
+                            )
+                    if self._speculate and (plan or spec_plan):
+                        # THE verify tick: decode-verify rows and prefill
+                        # chunks share one compiled program, exactly like
+                        # the mixed tick — greedy row argmaxes ride back
+                        # as a second output for the accept walk.
+                        rows_max = max(
+                            [p.rows for p in spec_plan.values()] or [1]
+                        )
+                        # Draft-less ticks (nothing proposed anywhere)
+                        # run the Tq=1 shape — low-acceptance traffic
+                        # must not pay the padded verify bucket for
+                        # nothing.
+                        tq = (
+                            self._spec_bucket(rows_max) if rows_max > 1
+                            else 1
+                        )
+                        if plan:
+                            tq = max(tq, self._chunk_bucket(
+                                max(n for _, n, _ in plan)
+                            ))
+                        spec_width = tq
+                        mat = np.zeros((self.slots, tq), np.int32)
+                        n_vec = np.zeros((self.slots,), np.int32)
+                        reset = np.zeros((self.slots,), bool)
+                        reset_val = np.zeros((self.slots,), np.int32)
+                        emit = np.zeros((self.slots,), bool)
+                        # Parked first tokens (whole-admission awaits)
+                        # exist only in the device token vector — their
+                        # row 0 must come from there, everyone else's
+                        # from the host matrix. Computed BEFORE chunk
+                        # consumption flips final-chunk slots to await.
+                        use_dev0 = np.asarray(
+                            [st == "await" for st in self._slot_state]
+                        )
+                        need_tree = False
+                        for i, pack in spec_plan.items():
+                            r = pack.rows
+                            self._ensure_blocks(i, self._slot_clen[i] + r)
+                            mat[i, :r] = pack.row_tokens
+                            n_vec[i] = r
+                            # reset_val IS the rollback: the device
+                            # length over-counts by last tick's rejected
+                            # rows until this reset.
+                            reset[i] = True
+                            reset_val[i] = self._slot_clen[i]
+                            if not np.array_equal(
+                                pack.depth, np.arange(r, dtype=np.int32)
+                            ):
+                                need_tree = True
+                        for slot, n, last in plan:
+                            self._ensure_blocks(
+                                slot, self._prefill_pos[slot] + n
+                            )
+                            rows, first = self._consume_chunk(slot, n,
+                                                              last)
+                            mat[slot, :n] = rows
+                            n_vec[slot] = n
+                            reset[slot] = first
+                            reset_val[slot] = self._prefill_start[slot]
+                            emit[slot] = last
+                        self._sync_table()
+                        args = (
+                            self.params, jnp.asarray(mat), self.tok,
+                            jnp.asarray(use_dev0), jnp.asarray(n_vec),
+                            jnp.asarray(reset), jnp.asarray(reset_val),
+                            jnp.asarray(emit),
+                        )
+                        if need_tree:
+                            # Per-slot depths + ancestor bitmasks; chain
+                            # slots (and prefill chunks) ride the arange/
+                            # lower-triangular defaults — the causal rule
+                            # bit-for-bit.
+                            depth_m = np.tile(
+                                np.arange(tq, dtype=np.int32),
+                                (self.slots, 1),
+                            )
+                            bits_m = np.broadcast_to(
+                                np.tril(np.ones((tq, tq), bool)),
+                                (self.slots, tq, tq),
+                            ).copy()
+                            for i, pack in spec_plan.items():
+                                r = pack.rows
+                                depth_m[i, :r] = pack.depth
+                                bits_m[i, :r, :r] = pack.anc
+                            all_tok_dev, self.cache, \
+                                self._key = self._spec_tree(
+                                    *args, jnp.asarray(depth_m),
+                                    jnp.asarray(bits_m), self.cache,
+                                    self._key,
+                                )
+                        else:
+                            all_tok_dev, self.cache, \
+                                self._key = self._spec_lin(
+                                    *args, self.cache, self._key
+                                )
+                        self.tok = all_tok_dev[:, 0]
+                        stepped = True
+                        if self._prefix is not None:
+                            for slot, n, last in plan:
+                                if last:
+                                    self._publish_prefix(slot)
+                    elif plan:
                         # The fused mixed tick: decode rows + prefill
                         # chunks in ONE compiled program; chunks write
                         # straight into each slot's region of the batch
@@ -1509,6 +2015,7 @@ class SlotServer:
                               if st == "await"]
                     host_sync = bool(awaits or live_idx)
                     tokens_this_tick = 0
+                    alltok_host = None
                     if host_sync:
                         # THE per-tick host sync: every new token of this
                         # tick — decode samples, fused final-chunk first
@@ -1519,8 +2026,15 @@ class SlotServer:
                         # below), letting consecutive chunks pipeline in
                         # the dispatch queue. A live slot always enters
                         # its tick with a fresh ``_tok_host`` — it went
-                        # live inside this block.
-                        self._tok_host = np.asarray(self.tok)
+                        # live inside this block. A verify tick fetches
+                        # its fused (S, 1+Tq) output instead: the token
+                        # vector AND every row argmax in the same sync.
+                        if all_tok_dev is not None:
+                            fused_host = np.asarray(all_tok_dev)
+                            self._tok_host = fused_host[:, 0]
+                            alltok_host = fused_host[:, 1:]
+                        else:
+                            self._tok_host = np.asarray(self.tok)
                         now2 = time.monotonic()
                         if live_idx:
                             decode_ticks += 1
@@ -1530,6 +2044,14 @@ class SlotServer:
                             first = int(self._tok_host[i])
                             self._slot_tokens[i] = [first]
                             self._slot_state[i] = "live"
+                            # Committed cache rows = the prompt; the
+                            # first token is the pending tip (spec mode's
+                            # rollback ledger starts here).
+                            self._slot_clen[i] = len(req.prompt)
+                            if self._speculate:
+                                hl = self._hist_len[i]
+                                self._hist_buf[i, hl] = first
+                                self._hist_len[i] = hl + 1
                             _, vis = self._slot_admit[i]
                             self._slot_ttft[i] = max(now2 - vis, 0.0)
                             self._last_tok_t[i] = now2
@@ -1552,28 +2074,40 @@ class SlotServer:
                             elif req.max_new_tokens <= 1:
                                 self._retire(i, tick, "max_tokens",
                                              results)
-                        for i in live_idx:
-                            req = self._slot_req[i]
-                            tok_i = int(self._tok_host[i])
-                            self._slot_tokens[i].append(tok_i)
-                            tokens += 1
-                            tokens_this_tick += 1
-                            gap = max(now2 - self._last_tok_t[i], 0.0)
-                            tbt.append(gap)
-                            self._last_tok_t[i] = now2
-                            if gap > self._slot_max_tbt[i]:
-                                self._slot_max_tbt[i] = gap
-                            self.slo.observe_tbt(gap)
-                            if obs.REGISTRY.enabled:
-                                _TOKENS.inc()
-                                _TBT.observe(gap)
-                            if req.eos_id is not None \
-                                    and tok_i == req.eos_id:
-                                self._retire(i, tick, "eos", results)
-                            elif (len(self._slot_tokens[i])
-                                    >= req.max_new_tokens):
-                                self._retire(i, tick, "max_tokens",
-                                             results)
+                        if self._speculate:
+                            # Spec mode: live-slot tokens come from the
+                            # verify walk over the fetched row argmaxes,
+                            # 1..draft_k+1 of them per slot per tick.
+                            if spec_plan:
+                                n_new = self._spec_commit_all(
+                                    spec_plan, alltok_host,
+                                    spec_width, now2, tick, results, tbt,
+                                )
+                                tokens += n_new
+                                tokens_this_tick += n_new
+                        else:
+                            for i in live_idx:
+                                req = self._slot_req[i]
+                                tok_i = int(self._tok_host[i])
+                                self._slot_tokens[i].append(tok_i)
+                                tokens += 1
+                                tokens_this_tick += 1
+                                gap = max(now2 - self._last_tok_t[i], 0.0)
+                                tbt.append(gap)
+                                self._last_tok_t[i] = now2
+                                if gap > self._slot_max_tbt[i]:
+                                    self._slot_max_tbt[i] = gap
+                                self.slo.observe_tbt(gap)
+                                if obs.REGISTRY.enabled:
+                                    _TOKENS.inc()
+                                    _TBT.observe(gap)
+                                if req.eos_id is not None \
+                                        and tok_i == req.eos_id:
+                                    self._retire(i, tick, "eos", results)
+                                elif (len(self._slot_tokens[i])
+                                        >= req.max_new_tokens):
+                                    self._retire(i, tick, "max_tokens",
+                                                 results)
                     if obs.TRACER.active:
                         tick_span.set(host_sync=host_sync,
                                       tokens=tokens_this_tick)
@@ -1625,6 +2159,13 @@ class SlotServer:
                         rec["kv_frag"] = round(
                             1.0 - written / (mapped * self.kv_block), 4
                         ) if mapped else 0.0
+                    if self._speculate:
+                        s_slots, s_prop, s_acc = self._tick_spec
+                        rec["spec_verify"] = {
+                            "slots": s_slots,
+                            "proposed": s_prop,
+                            "accepted": s_acc,
+                        }
                     FLIGHT.record(rec)
                 self.slo.maybe_export(now)
 
@@ -1689,6 +2230,24 @@ class SlotServer:
                 "blocks_free": self._pool.free_count,
                 "peak_blocks_used": self._peak_blocks_used,
             }
+        spec_snap: Dict[str, Any] = {}
+        if self._speculate:
+            prop = self._spec_proposed - spec0[0]
+            acc = self._spec_accepted - spec0[1]
+            spec_snap = {
+                "drafter": type(self._drafter).__name__,
+                "draft_k": self.draft_k,
+                "proposed": prop,
+                "accepted": acc,
+                "acceptance_rate": round(acc / prop, 4) if prop else 0.0,
+                "verify_ticks": self._spec_ticks - spec0[2],
+                # Accepted drafts per per-SLOT verify event, plus the
+                # always-free bonus token (1 = no win, draft_k + 1 =
+                # perfect): the per-slot speedup lever.
+                "tokens_per_verify": round(
+                    1.0 + acc / (self._spec_verifies - spec0[3]), 4
+                ) if self._spec_verifies - spec0[3] else 0.0,
+            }
         log.info(
             "served %d request(s): %d tokens over %d decode tick(s), "
             "%.1f tok/s, mean occupancy %.2f/%d",
@@ -1706,4 +2265,5 @@ class SlotServer:
             slo=slo_snap,
             prefix=prefix_snap,
             kv=kv_snap,
+            spec=spec_snap,
         )

@@ -101,6 +101,9 @@ class RunConfig:
     #                                 prefix-block with the cache on, else 64)
     kv_blocks: Optional[int] = None  # TOTAL pool capacity in blocks (None ->
     #                                  slots * ceil(cache_len / kv_block))
+    speculate: bool = False  # draft-and-verify speculative decoding
+    draft_k: int = 4         # max draft tokens per slot per verify tick
+    drafter: str = "ngram"   # ngram | ngram-tree | model
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -309,6 +312,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "kv_block), the contiguous layout's bytes). "
                         "Smaller over-subscribes: admissions wait for "
                         "free blocks instead of failing")
+    p.add_argument("--speculate", action="store_true", default=d.speculate,
+                   help="serve mode: draft-and-verify speculative "
+                        "decoding (arXiv:2211.17192) on the mixed-Tq "
+                        "tick — a host drafter proposes tokens, ONE "
+                        "verify step scores them all, accepted prefixes "
+                        "commit in a burst, rejections roll back. Greedy "
+                        "only (--temperature 0); committed tokens are "
+                        "token-for-token identical to non-speculative "
+                        "decode")
+    p.add_argument("--draft-k", type=int, default=d.draft_k,
+                   help="serve mode: max draft tokens per slot per "
+                        "verify tick (1..31); one verify commits 1 to "
+                        "draft_k+1 tokens")
+    p.add_argument("--drafter", choices=["ngram", "ngram-tree", "model"],
+                   default=d.drafter,
+                   help="serve mode: 'ngram' = prompt-lookup over the "
+                        "slot's own history (zero extra model); "
+                        "'ngram-tree' = multi-branch token trees "
+                        "verified under the tree-attention ancestor "
+                        "mask (SpecInfer, arXiv:2305.09781); 'model' = "
+                        "a shrunk draft transformer (half depth, same "
+                        "vocab, --seed+3)")
     p.add_argument("--prefix-share", type=float, default=d.prefix_share,
                    help="serve mode: fraction of the synthetic trace's "
                         "requests drawing their prompt head from a shared "
