@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 
 from tree_attention_tpu.ops import attention_naive, flash_attention
-from tree_attention_tpu.ops.pallas_attention import attention_pallas_fwd
 from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
 from tests.oracles import sdpa_grads, sdpa_out_lse
 

@@ -1,0 +1,690 @@
+"""The invariant linter (tools/lint.py + tools/lintlib).
+
+Fixture-level contracts per pass — a known-bad snippet fires, the
+matching known-good idiom (lifted from the real call sites) stays clean,
+and the ``# lint: allow[rule] reason`` grammar suppresses — plus the
+package-wide runs: the WHOLE repo is lint-clean against an EMPTY
+baseline, and the runner exits nonzero the moment a new violation
+appears.
+
+Pure AST: importing tools.lintlib (and this file) must not import jax —
+pinned by a test, and what keeps the suite's share of the tier-1 budget
+in the milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from tools import lintlib
+from tools.lint import main as lint_main
+
+ENGINE = "tree_attention_tpu/serving/engine.py"
+OPS_DECODE = "tree_attention_tpu/ops/decode.py"
+PALLAS = "tree_attention_tpu/ops/pallas_decode.py"
+OBS_FLIGHT = "tree_attention_tpu/obs/flight.py"
+
+
+def run(rule, text, path=ENGINE):
+    return lintlib.run_source(rule, text, path)
+
+
+def messages(findings):
+    return [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# obs-guard
+
+
+class TestObsGuard:
+    def test_unguarded_instant_args_flagged(self):
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def f(x):\n"
+            "    obs.instant('evt', cat='serving', args={'x': x})\n"
+        ))
+        assert len(fs) == 1 and "TRACER.active" in fs[0].message
+
+    def test_guarded_instant_clean(self):
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def f(x):\n"
+            "    if obs.TRACER.active:\n"
+            "        obs.instant('evt', cat='serving', args={'x': x})\n"
+        ))
+        assert fs == []
+
+    def test_span_args_ifexp_idiom_clean(self):
+        # The repo's canonical form: allocation only on the else branch.
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def f(tick):\n"
+            "    with obs.span('serving:tick', cat='serving',\n"
+            "                  args=None if not obs.TRACER.active else\n"
+            "                  {'tick': tick}):\n"
+            "        pass\n"
+        ))
+        assert fs == []
+
+    def test_span_args_dict_unguarded_flagged(self):
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def f(tick):\n"
+            "    with obs.span('t', cat='serving', args={'tick': tick}):\n"
+            "        pass\n"
+        ))
+        assert len(fs) == 1
+
+    def test_labels_chain_needs_guard(self):
+        base = (
+            "from tree_attention_tpu import obs\n"
+            "_REQS = obs.counter('reqs_total', 'h', labels=('outcome',))\n"
+            "def f(outcome):\n"
+            "{body}"
+        )
+        bad = base.format(
+            body="    _REQS.labels(outcome=outcome).inc()\n")
+        good = base.format(body=(
+            "    if obs.REGISTRY.enabled:\n"
+            "        _REQS.labels(outcome=outcome).inc()\n"))
+        assert len(run("obs-guard", bad)) == 1
+        assert run("obs-guard", good) == []
+
+    def test_bare_inc_is_free_when_disabled(self):
+        # metrics.py's documented unconditional-record design: no
+        # allocation before the internal flag check.
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "_T = obs.counter('toks_total', 'h')\n"
+            "def f(n):\n"
+            "    _T.inc()\n"
+            "    _T.inc(n * 4)\n"
+        ))
+        assert fs == []
+
+    def test_early_return_guard_dominates(self):
+        # ops/decode.py:_account_dispatch shape.
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "_D = obs.counter('d_total', 'h', labels=('path',))\n"
+            "def account(path):\n"
+            "    if not obs.REGISTRY.enabled:\n"
+            "        return\n"
+            "    _D.labels(path=path).inc()\n"
+        ))
+        assert fs == []
+
+    def test_or_combined_guard_accepted(self):
+        # cli.py's crash-handler arm: any instrument on => not the
+        # disabled path, allocation is paid by an enabled run.
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "_T = obs.counter('t_total', 'h', labels=('k',))\n"
+            "def f(k):\n"
+            "    if obs.REGISTRY.enabled or obs.TRACER.active:\n"
+            "        _T.labels(k=k).inc()\n"
+        ))
+        assert fs == []
+
+    def test_flight_record_guarded_vs_not(self):
+        base = (
+            "from tree_attention_tpu.obs.flight import FLIGHT\n"
+            "def tick(n):\n"
+            "{body}"
+        )
+        bad = base.format(body="    FLIGHT.record({'tick': n})\n")
+        good = base.format(body=(
+            "    if FLIGHT.enabled:\n"
+            "        FLIGHT.record({'tick': n})\n"))
+        assert len(run("obs-guard", bad)) == 1
+        assert run("obs-guard", good) == []
+
+    def test_span_set_needs_tracer_guard(self):
+        base = (
+            "from tree_attention_tpu import obs\n"
+            "def f(tok):\n"
+            "    tick_span = obs.span('t', cat='serving')\n"
+            "    with tick_span:\n"
+            "{body}"
+        )
+        bad = base.format(body="        tick_span.set(tokens=tok)\n")
+        good = base.format(body=(
+            "        if obs.TRACER.active:\n"
+            "            tick_span.set(tokens=tok)\n"))
+        assert len(run("obs-guard", bad)) == 1
+        assert run("obs-guard", good) == []
+
+    def test_or_with_non_guard_disjunct_rejected(self):
+        # Review finding: `REGISTRY.enabled or DEBUG` runs with all
+        # telemetry off whenever DEBUG is true — it guards nothing.
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "DEBUG = True\n"
+            "_T = obs.counter('t_total', 'h', labels=('k',))\n"
+            "def f(k):\n"
+            "    if obs.REGISTRY.enabled or DEBUG:\n"
+            "        _T.labels(k=k).inc()\n"
+        ))
+        assert len(fs) == 1
+
+    def test_and_with_non_guard_operand_still_guards(self):
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "_T = obs.counter('t_total', 'h', labels=('k',))\n"
+            "def f(k, m):\n"
+            "    if obs.REGISTRY.enabled and m:\n"
+            "        _T.labels(k=k).inc()\n"
+        ))
+        assert fs == []
+
+    def test_match_case_bodies_are_walked(self):
+        # Review finding: ast.Match case bodies are stmt lists, not
+        # exprs — the walker must descend or emissions hide under match.
+        base = (
+            "from tree_attention_tpu import obs\n"
+            "_T = obs.counter('t_total', 'h', labels=('k',))\n"
+            "def f(mode, k):\n"
+            "    match mode:\n"
+            "        case 1:\n"
+            "{body}"
+        )
+        bad = base.format(body="            _T.labels(k=k).inc()\n")
+        good = base.format(body=(
+            "            if obs.REGISTRY.enabled:\n"
+            "                _T.labels(k=k).inc()\n"))
+        assert len(run("obs-guard", bad)) == 1
+        assert run("obs-guard", good) == []
+
+    def test_obs_internals_out_of_scope(self):
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def f(x):\n"
+            "    obs.instant('evt', cat='x', args={'x': x})\n"
+        ), path=OBS_FLIGHT)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+
+
+class TestHostSync:
+    BAD_SERVE = (
+        "import numpy as np\n"
+        "class SlotServer:\n"
+        "    def serve(self, requests):\n"
+        "        toks = np.asarray(self.tok)\n"
+    )
+
+    def test_device_asarray_in_serve_flagged(self):
+        fs = run("host-sync", self.BAD_SERVE)
+        assert len(fs) == 1 and "np.asarray" in fs[0].message
+
+    def test_allow_with_reason_suppresses(self):
+        fs = run("host-sync", self.BAD_SERVE.replace(
+            "        toks = np.asarray(self.tok)\n",
+            "        # lint: allow[host-sync] THE per-tick fetch\n"
+            "        toks = np.asarray(self.tok)\n",
+        ))
+        assert fs == []
+
+    def test_allow_without_reason_is_a_finding(self):
+        fs = run("host-sync", self.BAD_SERVE.replace(
+            "        toks = np.asarray(self.tok)\n",
+            "        # lint: allow[host-sync]\n"
+            "        toks = np.asarray(self.tok)\n",
+        ))
+        assert len(fs) == 1 and "needs a reason" in fs[0].message
+
+    def test_wrong_rule_allow_does_not_suppress(self):
+        fs = run("host-sync", self.BAD_SERVE.replace(
+            "        toks = np.asarray(self.tok)\n",
+            "        # lint: allow[obs-guard] not this rule\n"
+            "        toks = np.asarray(self.tok)\n",
+        ))
+        assert len(fs) == 1
+
+    def test_list_literal_asarray_clean(self):
+        fs = run("host-sync", (
+            "import numpy as np\n"
+            "class SlotServer:\n"
+            "    def serve(self, requests):\n"
+            "        use = np.asarray([s == 'await' for s in self.st])\n"
+        ))
+        assert fs == []
+
+    def test_item_and_block_until_ready_flagged(self):
+        fs = run("host-sync", (
+            "class SlotServer:\n"
+            "    def serve(self, requests):\n"
+            "        x = self.tok.item()\n"
+            "        self.cache.k.block_until_ready()\n"
+        ))
+        assert len(fs) == 2
+
+    def test_int_on_tainted_local_flagged_param_exempt(self):
+        fs = run("host-sync", (
+            "import jax.numpy as jnp\n"
+            "class SlotServer:\n"
+            "    def serve(self, requests, q_position):\n"
+            "        dev = jnp.zeros((4,))\n"
+            "        a = int(dev[0])\n"          # tainted local -> flag
+            "        b = int(q_position)\n"      # param -> exempt
+        ))
+        assert len(fs) == 1 and "dev" in fs[0].message
+
+    def test_ops_dispatch_scope(self):
+        fs = run("host-sync", (
+            "import jax\n"
+            "def flash_decode(q, k, v):\n"
+            "    return jax.device_get(q)\n"
+        ), path=OPS_DECODE)
+        assert len(fs) == 1
+
+    def test_other_files_unscoped(self):
+        fs = run("host-sync", self.BAD_SERVE,
+                 path="tree_attention_tpu/bench/serving.py")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hygiene
+
+
+class TestRecompileHygiene:
+    def test_raw_length_shape_var_flagged(self):
+        fs = run("recompile-hygiene", (
+            "class S:\n"
+            "    def f(self, plen):\n"
+            "        tq = plen\n"
+        ))
+        assert len(fs) == 1 and "tq" in fs[0].message
+
+    def test_bucketed_shape_vars_clean(self):
+        fs = run("recompile-hygiene", (
+            "class S:\n"
+            "    def f(self, plan, rows_max, prompt):\n"
+            "        tq = self._spec_bucket(rows_max) if rows_max > 1 else 1\n"
+            "        tq = max(tq, self._chunk_bucket(8))\n"
+            "        bucket = _bucket(plan, self.cache_len)\n"
+            "        bucket = prompt.shape[1]\n"
+        ))
+        assert fs == []
+
+    def test_module_scope_jnp_flagged(self):
+        fs = run("recompile-hygiene", (
+            "import jax.numpy as jnp\n"
+            "_TABLE = jnp.arange(128)\n"
+        ), path=OPS_DECODE)
+        assert len(fs) == 1 and "module-scope" in fs[0].message
+
+    def test_function_scope_jnp_clean(self):
+        fs = run("recompile-hygiene", (
+            "import jax.numpy as jnp\n"
+            "def f():\n"
+            "    return jnp.arange(128)\n"
+        ), path=OPS_DECODE)
+        assert fs == []
+
+    def test_python_if_on_traced_value_flagged(self):
+        fs = run("recompile-hygiene", (
+            "import jax\n"
+            "def _step_fn(x, n):\n"
+            "    if n > 0:\n"
+            "        return x\n"
+            "    return x * 2\n"
+            "_step = jax.jit(_step_fn)\n"
+        ), path=OPS_DECODE)
+        assert len(fs) == 1 and "'n'" in fs[0].message
+
+    def test_static_trace_time_tests_clean(self):
+        fs = run("recompile-hygiene", (
+            "import jax\n"
+            "def _step_fn(x, mask=None):\n"
+            "    if mask is None:\n"
+            "        return x\n"
+            "    if x.shape[0] > 8:\n"
+            "        return x\n"
+            "    return x * 2\n"
+            "_step = jax.jit(_step_fn)\n"
+        ), path=OPS_DECODE)
+        assert fs == []
+
+    def test_static_argname_param_may_branch(self):
+        fs = run("recompile-hygiene", (
+            "import jax\n"
+            "def _step_fn(x, n):\n"
+            "    if n > 0:\n"
+            "        return x\n"
+            "    return x * 2\n"
+            "_step = jax.jit(_step_fn, static_argnames=('n',))\n"
+        ), path=OPS_DECODE)
+        assert fs == []
+
+    def test_unhashable_static_arg_at_call_site(self):
+        fs = run("recompile-hygiene", (
+            "import jax\n"
+            "def _step_fn(x, sizes):\n"
+            "    return x\n"
+            "_step = jax.jit(_step_fn, static_argnames=('sizes',))\n"
+            "def caller(x):\n"
+            "    return _step(x, sizes=[1, 2, 3])\n"
+        ), path=OPS_DECODE)
+        assert len(fs) == 1 and "unhashable" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# pallas-contract
+
+
+class TestPallasContract:
+    def test_lambda_capturing_array_flagged(self):
+        fs = run("pallas-contract", (
+            "import jax.numpy as jnp\n"
+            "def build(table):\n"
+            "    tbl = jnp.asarray(table, jnp.int32)\n"
+            "    spec = pl.BlockSpec((1, 8, 8),\n"
+            "                        lambda b, i: (tbl[b, i], 0, 0))\n"
+        ), path=PALLAS)
+        assert len(fs) == 1 and "tbl" in fs[0].message
+
+    def test_factory_int_closure_clean(self):
+        # The _paged_kv_map idiom: static int baked at trace time.
+        fs = run("pallas-contract", (
+            "def _paged_kv_map(n_kv_heads):\n"
+            "    def index_map(bh, qi, si, offs_ref, tbl_ref):\n"
+            "        return (tbl_ref[bh // n_kv_heads, si],\n"
+            "                bh % n_kv_heads, 0, 0)\n"
+            "    return index_map\n"
+        ), path=PALLAS)
+        assert fs == []
+
+    def test_index_map_mutation_flagged(self):
+        fs = run("pallas-contract", (
+            "_STATE = {}\n"
+            "def build():\n"
+            "    def index_map(bh, qi, si):\n"
+            "        _STATE['last'] = si\n"
+            "        return (bh, qi, 0)\n"
+            "    spec = pl.BlockSpec((1, 8, 8), index_map)\n"
+        ), path=PALLAS)
+        assert any("pure" in m for m in messages(fs))
+
+    def test_scalar_prefetch_not_int32_flagged(self):
+        code = (
+            "import jax.numpy as jnp\n"
+            "def paged_call(kernel, offs_raw, table, q):\n"
+            "    tbl = jnp.asarray(table{dtype})\n"
+            "    grid_spec = pltpu.PrefetchScalarGridSpec(\n"
+            "        num_scalar_prefetch=2, grid=(1,))\n"
+            "    return pl.pallas_call(kernel, grid_spec=grid_spec)(\n"
+            "        offsets_smem(0, 0, 4), tbl, q)\n"
+        )
+        bad = run("pallas-contract", code.format(dtype=""), path=PALLAS)
+        good = run("pallas-contract",
+                   code.format(dtype=", jnp.int32"), path=PALLAS)
+        assert len(bad) == 1 and "int32" in bad[0].message
+        assert good == []
+
+    def test_tree_bits_needs_limit_check(self):
+        base = (
+            "def kernel_entry(tree_mask, G, Hkv, bq, n_q):\n"
+            "{guard}"
+            "    tb = _tree_bits_rows(tree_mask, G, Hkv, bq, n_q)\n"
+            "    return tb\n"
+        )
+        bad = base.format(guard="")
+        good = base.format(guard=(
+            "    if tree_mask.shape[1] > 32:\n"
+            "        raise ValueError('Tq exceeds 32')\n"))
+        assert len(run("pallas-contract", bad, path=PALLAS)) == 1
+        assert run("pallas-contract", good, path=PALLAS) == []
+
+    def test_only_pallas_files_scoped(self):
+        fs = run("pallas-contract", (
+            "import jax.numpy as jnp\n"
+            "def build(table):\n"
+            "    tbl = jnp.asarray(table, jnp.int32)\n"
+            "    spec = pl.BlockSpec((1, 8), lambda b: (tbl[b], 0))\n"
+        ), path=OPS_DECODE)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lock-safety
+
+
+class TestLockSafety:
+    def test_unlocked_mutation_flagged(self):
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class Rec:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._ring = []\n"
+            "    def record(self, rec):\n"
+            "        self._ring.append(rec)\n"
+        ), path=OBS_FLIGHT)
+        assert len(fs) == 1 and "self._ring" in fs[0].message
+
+    def test_locked_mutation_and_flag_attr_clean(self):
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class Rec:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._ring = []\n"
+            "        self.enabled = False\n"
+            "    def arm(self):\n"
+            "        with self._lock:\n"
+            "            self._ring.append(0)\n"
+            "        self.enabled = True\n"  # the lock-free fast-path flag
+        ), path=OBS_FLIGHT)
+        assert fs == []
+
+    def test_plain_lock_on_crash_path_flagged(self):
+        base = (
+            "import threading\n"
+            "class Sink:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.{lock}()\n"
+            "    def flush(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        bad = run("lock-safety", base.format(lock="Lock"),
+                  path=OBS_FLIGHT)
+        good = run("lock-safety", base.format(lock="RLock"),
+                   path=OBS_FLIGHT)
+        assert len(bad) == 1 and "RLock" in bad[0].message
+        assert good == []
+
+    def test_plain_lock_via_from_import_still_flagged(self):
+        # Review finding: `from threading import Lock` must not dodge
+        # the RLock requirement.
+        fs = run("lock-safety", (
+            "from threading import Lock\n"
+            "class Sink:\n"
+            "    def __init__(self):\n"
+            "        self._lock = Lock()\n"
+            "    def flush(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        ), path=OBS_FLIGHT)
+        assert len(fs) == 1 and "RLock" in fs[0].message
+
+    def test_non_crash_class_may_use_plain_lock(self):
+        # slo.py's monitor: not on the signal path, Lock is fine.
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class Mon:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def observe(self, v):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        ), path="tree_attention_tpu/obs/slo.py")
+        assert fs == []
+
+    def test_signal_path_emission_flagged(self):
+        fs = run("lock-safety", (
+            "def flush():\n"
+            "    _FLUSHES.inc()\n"
+            "    return None\n"
+        ), path="tree_attention_tpu/obs/__init__.py")
+        assert len(fs) == 1 and "signal-path" in fs[0].message
+
+    def test_signal_path_reaches_callees(self):
+        fs = run("lock-safety", (
+            "def flush():\n"
+            "    _write_all()\n"
+            "def _write_all():\n"
+            "    obs.instant('flushed', cat='obs')\n"
+        ), path="tree_attention_tpu/obs/__init__.py")
+        assert len(fs) == 1
+
+    def test_outside_obs_unscoped(self):
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class Rec:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def flush(self):\n"
+            "        self._x = 1\n"
+        ), path=ENGINE)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# the package itself + runner semantics
+
+
+class TestFullPackage:
+    def test_whole_repo_is_clean_against_empty_baseline(self):
+        files = lintlib.discover_files()
+        findings = lintlib.run_passes(files)
+        assert [f.format() for f in findings] == []
+        # and the committed baseline really is empty
+        baseline = lintlib.load_baseline(
+            os.path.join(lintlib.REPO_ROOT, "tools", "lint_baseline.json"))
+        assert baseline == {}
+
+    def test_lintlib_never_imports_jax(self):
+        # A fresh interpreter importing + running every pass must pull in
+        # neither jax nor numpy — the property that keeps the linter
+        # tier-1-cheap and usable as a pre-commit hook.
+        import subprocess
+        code = (
+            "import sys; sys.path.insert(0, {root!r})\n"
+            "from tools import lintlib\n"
+            "lintlib.run_passes(['tools/lint.py'])\n"
+            "heavy = [m for m in sys.modules\n"
+            "         if m.split('.')[0] in ('jax', 'jaxlib', 'numpy')]\n"
+            "assert not heavy, heavy\n"
+        ).format(root=lintlib.REPO_ROOT)
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       cwd=lintlib.REPO_ROOT)
+
+    def test_engine_tick_fetch_is_annotated(self):
+        # The ONE per-tick host sync is allow[]-annotated, not unscoped.
+        path = os.path.join(lintlib.REPO_ROOT, ENGINE)
+        with open(path) as fh:
+            text = fh.read()
+        assert text.count("lint: allow[host-sync]") == 2
+
+
+class TestRunner:
+    BAD_ENGINE = (
+        "import numpy as np\n"
+        "class SlotServer:\n"
+        "    def serve(self, requests):\n"
+        "        return np.asarray(self.tok)\n"
+    )
+
+    def _fake_repo(self, tmp_path, bad=True):
+        pkg = tmp_path / "tree_attention_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (tmp_path / "tools").mkdir()
+        (pkg / "engine.py").write_text(
+            self.BAD_ENGINE if bad else "x = 1\n")
+        return str(tmp_path)
+
+    def test_exit_1_on_new_violation(self, tmp_path, capsys):
+        root = self._fake_repo(tmp_path)
+        bl = tmp_path / "baseline.json"
+        rc = lint_main(["--root", root, "--baseline", str(bl)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "host-sync" in out and "FAIL" in out
+
+    def test_exit_0_when_clean(self, tmp_path, capsys):
+        root = self._fake_repo(tmp_path, bad=False)
+        rc = lint_main(["--root", root,
+                        "--baseline", str(tmp_path / "b.json")])
+        assert rc == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_baseline_grandfathers_exactly_once(self, tmp_path, capsys):
+        root = self._fake_repo(tmp_path)
+        bl = tmp_path / "baseline.json"
+        rc = lint_main(["--root", root, "--baseline", str(bl),
+                        "--write-baseline"])
+        assert rc == 0 and bl.exists()
+        # same single finding -> baselined, exit 0
+        rc = lint_main(["--root", root, "--baseline", str(bl)])
+        capsys.readouterr()
+        assert rc == 0
+        # a SECOND identical violation exceeds the multiplicity
+        eng = (tmp_path / "tree_attention_tpu" / "serving" / "engine.py")
+        eng.write_text(self.BAD_ENGINE
+                       + "        y = np.asarray(self.cache)\n")
+        rc = lint_main(["--root", root, "--baseline", str(bl)])
+        assert rc == 1
+
+    def test_json_output_shape(self, tmp_path, capsys):
+        root = self._fake_repo(tmp_path)
+        rc = lint_main(["--root", root, "--json",
+                        "--baseline", str(tmp_path / "b.json")])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert data["new"] and data["findings"]
+        f = data["new"][0]
+        assert {"rule", "path", "line", "col", "message"} <= set(f)
+
+    def test_unknown_rule_errors(self, capsys):
+        rc = lint_main(["--rules", "no-such-pass"])
+        assert rc == 2
+
+    def test_absolute_file_paths_normalized_into_scope(self, tmp_path,
+                                                       capsys):
+        # Review finding: an absolute path spelling must not lint as
+        # out-of-scope-everything and report OK.
+        root = self._fake_repo(tmp_path)
+        abs_engine = os.path.join(root, "tree_attention_tpu", "serving",
+                                  "engine.py")
+        rc = lint_main(["--root", root,
+                        "--baseline", str(tmp_path / "b.json"),
+                        abs_engine])
+        out = capsys.readouterr().out
+        assert rc == 1 and "host-sync" in out
+
+    def test_write_baseline_refuses_subset_runs(self, tmp_path, capsys):
+        # Review finding: a subset run sees a subset of findings —
+        # writing it would erase every other entry in the baseline.
+        root = self._fake_repo(tmp_path)
+        bl = tmp_path / "baseline.json"
+        rc = lint_main(["--root", root, "--baseline", str(bl),
+                        "--rules", "obs-guard", "--write-baseline"])
+        assert rc == 2 and not bl.exists()
+        rc = lint_main(["--root", root, "--baseline", str(bl),
+                        "tree_attention_tpu/serving/engine.py",
+                        "--write-baseline"])
+        assert rc == 2 and not bl.exists()
+
+    def test_rules_filter(self, tmp_path, capsys):
+        root = self._fake_repo(tmp_path)
+        rc = lint_main(["--root", root, "--rules", "obs-guard",
+                        "--baseline", str(tmp_path / "b.json")])
+        assert rc == 0  # the host-sync finding is filtered out

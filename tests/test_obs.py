@@ -41,7 +41,6 @@ import pytest
 from tree_attention_tpu.obs.flight import FlightRecorder
 from tree_attention_tpu.obs.http import MetricsHTTPServer
 from tree_attention_tpu.obs.metrics import (
-    Histogram,
     MetricsRegistry,
     percentile,
 )

@@ -7,7 +7,6 @@ anchor everything else (Pallas kernels, tree merge) is tested against.
 
 import numpy as np
 import pytest
-import jax
 import jax.numpy as jnp
 
 from tree_attention_tpu.ops import (

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 
 from tree_attention_tpu.ops import attention_naive
-from tree_attention_tpu.parallel import cpu_mesh, make_mesh, tree_attention, tree_decode
+from tree_attention_tpu.parallel import cpu_mesh, tree_attention, tree_decode
 
 
 def make_qkv(rng, B=2, Hq=4, Hkv=4, Tq=8, Tk=256, D=32, dtype=np.float32):

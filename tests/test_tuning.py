@@ -8,7 +8,6 @@ move.
 """
 
 import jax.numpy as jnp
-import pytest
 
 from tree_attention_tpu.ops.tuning import (
     decode_block_k,

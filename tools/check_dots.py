@@ -31,8 +31,10 @@ import sys
 # 316 after PR 5 (radix prefix KV cache; 317 measured), 337 after PR 6
 # (paged KV; 338 measured, rc 0 — the five env-impossible test_cli
 # launch tests are conftest-skipped on legacy jaxlib now), 385 after
-# PR 7 (speculative decoding; 386 measured). Raise as PRs add tests.
-FLOOR = 385
+# PR 7 (speculative decoding; 386 measured), 441 after PR 8 (invariant
+# linter; 436 measured pre-review + 6 review-fix regression tests in
+# tests/test_lint.py = 442). Raise as PRs add tests.
+FLOOR = 441
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

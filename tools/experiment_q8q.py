@@ -44,7 +44,6 @@ def _q8q_kernel(q_ref, qs_ref, k_ref, v_ref, out_ref,
                 m_scr, l_scr, acc_scr, *, tk, q_offset, block_k):
     si = pl.program_id(1)
     n_s = pl.num_programs(1)
-    bq = q_ref.shape[1]
     bk = block_k
 
     @pl.when(si == 0)

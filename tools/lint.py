@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Run the repo's invariant linter (tools/lintlib) over the package.
+
+Pure-AST — no jax import, fast enough to run on every edit and in
+tier-1.  The five passes and the contracts they enforce are documented
+in ``tools/lintlib/__init__.py`` and ARCHITECTURE.md ("Invariants &
+static analysis").
+
+Usage:
+    python tools/lint.py                   # human output, baseline diff
+    python tools/lint.py --json            # machine output
+    python tools/lint.py --rules obs-guard host-sync
+    python tools/lint.py --no-baseline     # report ALL findings
+    python tools/lint.py --write-baseline  # grandfather current findings
+
+Exit 0 when no findings beyond the committed baseline
+(``tools/lint_baseline.json`` — EMPTY by policy; see the lintlib
+docstring), 1 when a NEW finding appeared, 2 on usage errors.
+
+Suppression: ``# lint: allow[<rule>] <reason>`` on the flagged line or
+the line above; the reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools import lintlib  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="repo-relative files (default: discover the "
+                         "package + tools)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--rules", nargs="+", default=None,
+                    metavar="RULE",
+                    help="run only these passes")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file to diff against")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "(requires a recorded reason in the PR)")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        lintlib._load_passes()
+        unknown = [r for r in args.rules if r not in lintlib.PASSES]
+        if unknown:
+            known = ", ".join(sorted(lintlib.PASSES))
+            print(f"lint: unknown rule(s) {unknown}; known: {known}",
+                  file=sys.stderr)
+            return 2
+
+    root = args.root or _REPO
+    if args.files:
+        # Scope filters match on repo-relative forward-slash paths; an
+        # absolute or ./-prefixed spelling must not silently lint as
+        # out-of-scope-everything and report OK.
+        files = [
+            os.path.relpath(f, root) if os.path.isabs(f)
+            else os.path.normpath(f)
+            for f in args.files
+        ]
+        files = [f.replace(os.sep, "/") for f in files]
+    else:
+        files = lintlib.discover_files(root)
+    findings = lintlib.run_passes(files, root=root, rules=args.rules)
+
+    if args.write_baseline:
+        if args.rules or args.files:
+            # A subset run sees a subset of findings; writing it would
+            # silently erase every other rule's/file's baseline entries.
+            print("lint: --write-baseline requires a full run "
+                  "(no --rules, no explicit files)", file=sys.stderr)
+            return 2
+        lintlib.write_baseline(args.baseline, findings)
+        print(f"lint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, _REPO)}")
+        return 0
+
+    baseline = ({} if args.no_baseline
+                else lintlib.load_baseline(args.baseline))
+    new = lintlib.new_findings(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "files": len(files),
+            "findings": [f.to_json() for f in findings],
+            "new": [f.to_json() for f in new],
+            "baselined": len(findings) - len(new),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        old = len(findings) - len(new)
+        status = "OK" if not new else "FAIL"
+        print(f"lint: {len(files)} files, {len(new)} new finding(s)"
+              + (f", {old} baselined" if old else "")
+              + f" {status}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,439 @@
+"""Repo-native static analysis: AST passes that machine-enforce the
+serving engine's hot-path contracts.
+
+Seven PRs of serving work rest on invariants that until now existed only
+as review convention.  Each pass here turns one of them into a machine
+check cheap enough for tier-1 (pure ``ast`` — importing this package must
+never import jax, numpy, or anything from ``tree_attention_tpu``):
+
+- ``obs-guard`` — every REGISTRY/TRACER/FLIGHT *emission* in hot-path
+  modules is dominated by the matching ``.enabled`` / ``.active`` check,
+  so the disabled path stays allocation-free (the zero-alloc contract
+  ``tests/test_obs.py`` measures is upheld at every call site, not just
+  the ones the test happens to cover).
+- ``host-sync`` — the serving tick loop pays exactly ONE host sync per
+  tick (Sarathi-Serve, arXiv:2403.02310: the stall-free tick IS the
+  product); any ``np.asarray`` / ``.item()`` / ``device_get`` /
+  ``block_until_ready`` inside ``SlotServer.serve`` or the ops dispatch
+  paths is flagged unless annotated ``# lint: allow[host-sync] reason``.
+- ``recompile-hygiene`` — raw prompt/Tq lengths must flow through the
+  pow2 bucket helpers before reaching the jitted program families;
+  module-scope ``jnp`` computation and Python ``if`` on traced values
+  are flagged.
+- ``pallas-contract`` — BlockSpec index maps are pure and closure-free
+  (module-level or factory-param closures only), scalar-prefetch
+  operands are explicitly int32, and the tree-mask bit packers are
+  reached only through a ``Tq <= 32`` guard (PagedAttention,
+  arXiv:2309.06180 — the table indirection lives in the index maps, so
+  a wrong dtype or an impure map corrupts the DMA schedule silently).
+- ``lock-safety`` — obs shared state is mutated only under its module
+  lock, crash-path classes use re-entrant locks, and the signal-handler
+  flush paths never emit telemetry (an emission inside a handler can
+  re-enter the very lock the interrupted thread holds).
+
+Suppression grammar (all passes): ``# lint: allow[<rule>] <reason>`` on
+the flagged line or the line above.  The reason is mandatory — an
+annotation without one is itself a finding.
+
+Baselines: ``tools/lint.py`` diffs findings against a committed baseline
+(``tools/lint_baseline.json``) keyed by ``rule|path|message`` (line
+numbers excluded, so unrelated edits never dirty the diff) and exits
+nonzero only on NEW findings.  The committed baseline is EMPTY — the
+whole package conforms — and should stay that way; the mechanism exists
+so a future grandfathered finding is an explicit, reviewable entry
+rather than a silent pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\[([a-z0-9_-]+)\]\s*(.*?)\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint violation. ``key`` deliberately omits the line/column so
+    baseline entries survive unrelated edits above the finding."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class Source:
+    """One parsed file: AST with parent links + the allow-comment map."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._lint_parent = parent  # type: ignore[attr-defined]
+        # line -> list of (rule, reason). Regex over raw lines: a string
+        # literal containing the marker would false-match, but the marker
+        # is namespaced enough that only lint's own fixtures ever spell it.
+        self.allows: Dict[int, List[tuple]] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = _ALLOW_RE.search(ln)
+            if m:
+                self.allows.setdefault(i, []).append((m.group(1), m.group(2)))
+
+    def allow_reason(self, rule: str, line: int) -> Optional[str]:
+        """Reason string for an allow[] covering ``line`` (same line or the
+        line above), or None when unsuppressed. An empty string means the
+        annotation exists but forgot its mandatory reason."""
+        for ln in (line, line - 1):
+            for r, reason in self.allows.get(ln, ()):
+                if r == rule:
+                    return reason
+        return None
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_lint_parent", None)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_none(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def emit(out: List[Finding], src: Source, rule: str, node: ast.AST,
+         message: str) -> None:
+    """Append a finding unless an allow[] with a reason suppresses it.
+    An allow[] WITHOUT a reason converts the finding instead of hiding
+    it — the annotation grammar's reason is part of the contract."""
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    reason = src.allow_reason(rule, line)
+    if reason is None:
+        out.append(Finding(rule, src.path, line, col, message))
+    elif not reason:
+        out.append(Finding(
+            rule, src.path, line, col,
+            f"allow[{rule}] annotation needs a reason: {message}",
+        ))
+
+
+# -- guard recognition (shared by obs-guard and lock-safety) ---------------
+
+#: The three telemetry instruments and the attribute that gates each.
+GUARD_KINDS = ("registry", "tracer", "flight")
+
+
+def _leaf_guard(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+        # obs.enabled() is the module-level REGISTRY.enabled shorthand.
+        if d and d.split(".")[-1] == "enabled":
+            return "registry"
+        return None
+    d = dotted(expr)
+    if not d:
+        return None
+    parts = d.split(".")
+    if len(parts) < 2:
+        return None
+    owner, attr = parts[-2], parts[-1]
+    if attr == "enabled" and owner.endswith("REGISTRY"):
+        return "registry"
+    if attr == "enabled" and owner.endswith("FLIGHT"):
+        return "flight"
+    if attr == "active" and owner.endswith("TRACER"):
+        return "tracer"
+    return None
+
+
+def guard_kinds(expr: Optional[ast.AST]) -> Set[str]:
+    """Guard kinds a true ``expr`` establishes.
+
+    ``or`` unions only when EVERY disjunct is itself a guard: a block
+    under ``REGISTRY.enabled or TRACER.active`` is unreachable when all
+    instruments are off (allocating registry labels while only the
+    tracer is live costs an enabled run — fine, and what the CLI's
+    combined crash-handler guard does), but ``REGISTRY.enabled or
+    DEBUG`` runs fully-disabled whenever DEBUG is true, so it guards
+    nothing.  ``and`` keeps every guard any operand asserts.
+    """
+    if expr is None:
+        return set()
+    if isinstance(expr, ast.BoolOp):
+        sets = [guard_kinds(v) for v in expr.values]
+        if isinstance(expr.op, ast.And) or all(sets):
+            return set().union(*sets)
+        return set()
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return set()
+    k = _leaf_guard(expr)
+    return {k} if k else set()
+
+
+def guard_kinds_negated(expr: Optional[ast.AST]) -> Set[str]:
+    """Guard kinds a FALSE ``expr`` establishes (the else branch of
+    ``if not GUARD`` / the tail after ``if not GUARD: return``)."""
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return guard_kinds(expr.operand)
+    return set()
+
+
+def terminates(body: Sequence[ast.stmt]) -> bool:
+    """Whether a block always leaves the enclosing suite."""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class GuardWalker:
+    """Statement/expression walker threading the set of telemetry guards
+    that dominate each node. Subclasses override :meth:`visit_expr_node`.
+
+    Handled guard shapes (the repo's actual idioms):
+
+    - ``if obs.REGISTRY.enabled: <emit>``
+    - ``if not obs.REGISTRY.enabled: return`` … ``<emit>``
+    - ``args=None if not obs.TRACER.active else {...}`` (IfExp branches)
+    - ``obs.TRACER.active and <emit>`` (short-circuit)
+    - ``while``/``with``/``try`` bodies inherit; nested ``def``/``class``
+      bodies reset (a closure defined under a guard may run anywhere).
+    """
+
+    def __init__(self, src: Source, findings: List[Finding]):
+        self.src = src
+        self.findings = findings
+
+    def run(self) -> None:
+        self.block(self.src.tree.body, frozenset())
+
+    # -- statements --------------------------------------------------------
+
+    def block(self, stmts: Sequence[ast.stmt], guards: frozenset) -> None:
+        live = set(guards)
+        for st in stmts:
+            self.statement(st, frozenset(live))
+            if (isinstance(st, ast.If) and not st.orelse
+                    and terminates(st.body)):
+                live |= guard_kinds_negated(st.test)
+
+    def statement(self, st: ast.stmt, guards: frozenset) -> None:
+        if isinstance(st, ast.If):
+            self.expr(st.test, guards)
+            self.block(st.body, guards | guard_kinds(st.test))
+            self.block(st.orelse, guards | guard_kinds_negated(st.test))
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in st.decorator_list:
+                self.expr(dec, guards)
+            self.enter_function(st)
+            self.block(st.body, frozenset())
+            self.leave_function(st)
+        elif isinstance(st, ast.ClassDef):
+            self.block(st.body, frozenset())
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.expr(st.iter, guards)
+            self.block(st.body, guards)
+            self.block(st.orelse, guards)
+        elif isinstance(st, ast.While):
+            self.expr(st.test, guards)
+            self.block(st.body, guards | guard_kinds(st.test))
+            self.block(st.orelse, guards)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.expr(item.context_expr, guards)
+            self.block(st.body, guards)
+        elif isinstance(st, ast.Try):
+            self.block(st.body, guards)
+            for h in st.handlers:
+                self.block(h.body, guards)
+            self.block(st.orelse, guards)
+            self.block(st.finalbody, guards)
+        elif isinstance(st, ast.Match):
+            # match_case bodies are stmt lists, not exprs — without this
+            # arm every emission under a case would walk unseen.
+            self.expr(st.subject, guards)
+            for case in st.cases:
+                if case.guard is not None:
+                    self.expr(case.guard, guards)
+                self.block(case.body, guards)
+        else:
+            self.visit_stmt(st, guards)
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self.expr(child, guards)
+
+    def enter_function(self, fn: ast.AST) -> None:  # hook
+        pass
+
+    def leave_function(self, fn: ast.AST) -> None:  # hook
+        pass
+
+    def visit_stmt(self, st: ast.stmt, guards: frozenset) -> None:  # hook
+        pass
+
+    # -- expressions -------------------------------------------------------
+
+    def expr(self, e: Optional[ast.AST], guards: frozenset) -> None:
+        if e is None or not isinstance(e, ast.expr):
+            return
+        if isinstance(e, ast.IfExp):
+            self.expr(e.test, guards)
+            self.expr(e.body, guards | guard_kinds(e.test))
+            self.expr(e.orelse, guards | guard_kinds_negated(e.test))
+            return
+        if isinstance(e, ast.BoolOp) and isinstance(e.op, ast.And):
+            acc = set(guards)
+            for v in e.values:
+                self.expr(v, frozenset(acc))
+                acc |= guard_kinds(v)
+            return
+        if isinstance(e, (ast.Lambda,)):
+            # A lambda body runs at call time, not here — guards reset.
+            self.expr(e.body, frozenset())
+            return
+        self.visit_expr_node(e, guards)
+        for child in ast.iter_child_nodes(e):
+            self.expr(child, guards)
+
+    def visit_expr_node(self, e: ast.expr, guards: frozenset) -> None:  # hook
+        pass
+
+
+# -- pass registry / running ----------------------------------------------
+
+#: rule name -> callable(Source) -> List[Finding]
+PASSES: Dict[str, Callable[[Source], List[Finding]]] = {}
+
+
+def lint_pass(rule: str):
+    def deco(fn):
+        PASSES[rule] = fn
+        fn.rule = rule
+        return fn
+    return deco
+
+
+def _load_passes() -> None:
+    # Imported lazily so ``import tools.lintlib`` stays cheap and cannot
+    # cycle; each module registers via @lint_pass at import.
+    from tools.lintlib import (  # noqa: F401
+        host_sync, locks, obs_guard, pallas, recompile,
+    )
+
+
+def discover_files(root: str = REPO_ROOT) -> List[str]:
+    """Repo-relative paths of every package/tools file the passes scope
+    over (each pass applies its own file filter on top)."""
+    out: List[str] = []
+    for base in ("tree_attention_tpu", "tools"):
+        for dirpath, dirnames, filenames in os.walk(os.path.join(root, base)):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__",)
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+    return out
+
+
+def run_passes(
+    files: Iterable[str],
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    root = root or REPO_ROOT
+    _load_passes()
+    selected = {r: p for r, p in PASSES.items()
+                if rules is None or r in rules}
+    findings: List[Finding] = []
+    for rel in files:
+        with open(os.path.join(root, rel), "r") as fh:
+            text = fh.read()
+        try:
+            src = Source(rel, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse", rel, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}",
+            ))
+            continue
+        for p in selected.values():
+            findings.extend(p(src))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def run_source(rule: str, text: str, path: str) -> List[Finding]:
+    """Run ONE pass over an in-memory snippet (the fixture-test entry
+    point; ``path`` matters — passes scope by it)."""
+    _load_passes()
+    return PASSES[rule](Source(path, text))
+
+
+# -- baseline --------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Baseline as key -> multiplicity (absent file = empty baseline)."""
+    try:
+        with open(path, "r") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    counts: Dict[str, int] = {}
+    for k in data.get("findings", []):
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[str, int]) -> List[Finding]:
+    """Findings beyond the baseline's per-key multiplicity — the only
+    ones that fail the run."""
+    remaining = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    with open(path, "w") as fh:
+        json.dump({"findings": sorted(f.key for f in findings)}, fh,
+                  indent=2)
+        fh.write("\n")

@@ -1,0 +1,334 @@
+"""pallas-contract: the decode kernels' scalar-prefetch and mask rules.
+
+The paged decode kernels dereference the block table INSIDE their
+BlockSpec index maps (PagedAttention, arXiv:2309.06180: the indirection
+lives in the prefetch-driven DMA schedule, not the kernel body).  That
+design concentrates three silent-corruption hazards in places ordinary
+tests reach poorly:
+
+- **index-map purity** — an index map runs at grid-schedule time.
+  Closing over static Python ints (grid/tile sizes, head counts) or
+  local index helpers is the repo's idiom and is fine — those are baked
+  at trace time.  Closing over an ARRAY is the classic paged-kernel bug
+  (e.g. capturing the block table instead of taking it as the
+  scalar-prefetch ref): the map silently computes from a value the DMA
+  schedule never sees.  Flagged: free names bound from ``jnp.``/
+  ``jax.``/``lax.``/``np.``/``*_smem`` calls or array-annotated
+  parameters, transitively through local helper functions.  Mutation
+  and ``global``/``nonlocal`` inside a map are flagged always.
+- **scalar-prefetch dtype** — SMEM scalar operands are int32 by kernel
+  contract (``offsets_smem`` builds them; the block table is asarray'd
+  with an explicit ``jnp.int32``).  A dtype-less ``jnp.asarray`` on an
+  offsets/table value picks up int64 on x64 hosts and reshapes the SMEM
+  window — flagged at the construction site.
+- **tree-mask bitmask limit** — the ancestor masks pack into int32
+  bitmasks (one bit per window column), so every caller of a
+  ``*tree_bits*`` packer must sit in a function that checks ``Tq <= 32``
+  and raises; draft widths are clamped upstream, but the kernel-side
+  guard is what turns a future wider caller into a clean error instead
+  of silently truncated visibility.
+
+Scope: ``ops/pallas_*.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.lintlib import Finding, Source, dotted, emit, lint_pass, parent
+
+RULE = "pallas-contract"
+
+
+def _in_scope(path: str) -> bool:
+    name = path.rsplit("/", 1)[-1]
+    return (path.startswith("tree_attention_tpu/")
+            and name.startswith("pallas") and name.endswith(".py"))
+
+
+import builtins as _builtins
+
+_BUILTINS = set(dir(_builtins))
+
+
+def _free_names(fn: ast.AST, params: Set[str]) -> Set[str]:
+    """Names loaded in ``fn``'s body that neither its params nor its own
+    assignments bind."""
+    bound = set(params)
+    free: Set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    bound.add(node.id)
+                elif node.id not in bound:
+                    free.add(node.id)
+    return free - _BUILTINS
+
+
+def _params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _enclosing_defs(node: ast.AST) -> List[ast.FunctionDef]:
+    out: List[ast.FunctionDef] = []
+    p = parent(node)
+    while p is not None:
+        if isinstance(p, ast.FunctionDef):
+            out.append(p)
+        p = parent(p)
+    return out
+
+
+def _array_annotated(arg: ast.arg) -> bool:
+    if arg.annotation is None:
+        return False
+    ann = ast.dump(arg.annotation)
+    return "Array" in ann or "ndarray" in ann
+
+
+def _array_suspects(scopes: List[ast.FunctionDef]) -> Set[str]:
+    """Names in the enclosing function scopes that plausibly hold
+    arrays: bound from jnp/jax/lax/np or ``*_smem`` calls, or parameters
+    annotated as arrays."""
+    suspects: Set[str] = set()
+    for fn in scopes:
+        a = fn.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            if _array_annotated(arg):
+                suspects.add(arg.arg)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            d = dotted(node.value.func) or ""
+            arrayish = (
+                d.startswith(("jnp.", "jax.", "lax.", "np.", "numpy."))
+                or d.split(".")[-1].endswith("_smem")
+                or d.split(".")[-1] in ("offsets_smem", "gather_paged_kv")
+            )
+            if not arrayish:
+                continue
+            for t in node.targets:
+                els = t.elts if isinstance(t, ast.Tuple) else [t]
+                for el in els:
+                    if isinstance(el, ast.Name):
+                        suspects.add(el.id)
+    return suspects
+
+
+def _local_defs(scopes: List[ast.FunctionDef]) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for fn in scopes:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                if isinstance(node, ast.FunctionDef):
+                    out.setdefault(node.name, node)
+    return out
+
+
+def _captured_suspects(fn: ast.AST, suspects: Set[str],
+                       helpers: Dict[str, ast.AST]) -> Set[str]:
+    """Array-suspect free names of ``fn``, following local helper
+    functions it calls (an index map that calls ``ki_live`` inherits
+    whatever ``ki_live`` captured)."""
+    out: Set[str] = set()
+    seen: Set[int] = set()
+    work: List[ast.AST] = [fn]
+    while work:
+        cur = work.pop()
+        if id(cur) in seen:
+            continue
+        seen.add(id(cur))
+        free = _free_names(cur, _params(cur))
+        out |= free & suspects
+        for name in free:
+            h = helpers.get(name)
+            if h is not None:
+                work.append(h)
+    return out
+
+
+def _check_index_maps(src: Source,
+                      findings: List[Finding]) -> None:
+    # Inline index maps: the 2nd positional / index_map kwarg of
+    # pl.BlockSpec(...) calls.
+    named_maps: Dict[str, ast.AST] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            named_maps.setdefault(node.name, node)
+    checked: Set[int] = set()
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and (dotted(node.func) or "").split(".")[-1] == "BlockSpec"):
+            continue
+        imap: Optional[ast.AST] = None
+        if len(node.args) > 1:
+            imap = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "index_map":
+                imap = kw.value
+        if imap is None:
+            continue
+        if isinstance(imap, ast.Lambda):
+            scopes = _enclosing_defs(imap)
+            bad = sorted(_captured_suspects(
+                imap, _array_suspects(scopes), _local_defs(scopes)))
+            if bad:
+                emit(findings, src, RULE, imap,
+                     f"BlockSpec index_map lambda captures array "
+                     f"value(s) {', '.join(bad)} — arrays must ride "
+                     f"scalar prefetch / kernel operands, never an "
+                     f"index-map closure")
+        elif isinstance(imap, ast.Name) and imap.id in named_maps:
+            target = named_maps[imap.id]
+            if id(target) not in checked:
+                checked.add(id(target))
+                _check_named_map(src, findings, target)
+        # A call like _paged_kv_map(Hkv) produces the map; its inner def
+        # is checked when the factory's body is scanned below.
+    # Factory-produced maps: any def whose name looks like an index map
+    # and is returned from a factory — free vars beyond the factory's
+    # params are the violation.
+    for node in ast.walk(src.tree):
+        if (isinstance(node, ast.FunctionDef)
+                and ("index_map" in node.name or node.name.endswith("_map"))
+                and _enclosing_defs(node)
+                and id(node) not in checked):
+            checked.add(id(node))
+            _check_named_map(src, findings, node)
+
+
+def _check_named_map(src: Source, findings: List[Finding],
+                     fn: ast.FunctionDef) -> None:
+    scopes = _enclosing_defs(fn)
+    bad = sorted(_captured_suspects(
+        fn, _array_suspects(scopes), _local_defs(scopes)))
+    if bad:
+        emit(findings, src, RULE, fn,
+             f"index map '{fn.name}' captures array value(s) "
+             f"{', '.join(bad)} — arrays must ride scalar prefetch / "
+             f"kernel operands, never an index-map closure")
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)) and any(
+            isinstance(t, (ast.Attribute, ast.Subscript))
+            for t in (node.targets if isinstance(node, ast.Assign)
+                      else [node.target])
+        ):
+            emit(findings, src, RULE, node,
+                 f"index map '{fn.name}' mutates external state — "
+                 f"index maps must be pure")
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            emit(findings, src, RULE, node,
+                 f"index map '{fn.name}' declares "
+                 f"{type(node).__name__.lower()} — index maps must be "
+                 f"pure")
+
+
+def _int32_ctor(expr: ast.AST) -> bool:
+    """Whether ``expr`` provably constructs int32 scalar operands."""
+    if not isinstance(expr, ast.Call):
+        return False
+    d = dotted(expr.func) or ""
+    last = d.split(".")[-1]
+    if last == "offsets_smem" or last == "_offsets_smem":
+        return True  # the (2, B) int32 helper in ops/block_utils.py
+    if last == "asarray":
+        dt = expr.args[1] if len(expr.args) > 1 else None
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                dt = kw.value
+        return dt is not None and (dotted(dt) or "").endswith("int32")
+    if last == "astype" and expr.args:
+        return (dotted(expr.args[0]) or "").endswith("int32")
+    return False
+
+
+def _check_scalar_prefetch(src: Source, findings: List[Finding]) -> None:
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        n_prefetch: Optional[int] = None
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and (dotted(node.func) or "").endswith(
+                        "PrefetchScalarGridSpec")):
+                for kw in node.keywords:
+                    if kw.arg == "num_scalar_prefetch" and isinstance(
+                            kw.value, ast.Constant):
+                        n_prefetch = kw.value.value
+        if n_prefetch is None:
+            continue
+        # names bound to sanctioned int32 constructors in this function
+        int32_names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _int32_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        int32_names.add(t.id)
+        # the pallas_call(...)‌(operands) invocation: first n_prefetch
+        # operands are the scalar-prefetch arrays
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Call)
+                    and (dotted(node.func.func) or "").endswith(
+                        "pallas_call")):
+                continue
+            for i, arg in enumerate(node.args[:n_prefetch]):
+                if isinstance(arg, ast.Starred):
+                    break  # cannot track; later args unknowable
+                ok = (_int32_ctor(arg)
+                      or (isinstance(arg, ast.Name)
+                          and arg.id in int32_names))
+                if not ok:
+                    name = (dotted(arg) or
+                            type(arg).__name__.lower())
+                    emit(findings, src, RULE, arg,
+                         f"scalar-prefetch operand {i} ({name}) of "
+                         f"'{fn.name}' is not provably int32 — build "
+                         f"it with offsets_smem(...) or "
+                         f"jnp.asarray(..., jnp.int32)")
+
+
+def _check_tree_bits(src: Source, findings: List[Finding]) -> None:
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        calls_packer = any(
+            isinstance(node, ast.Call)
+            and "tree_bits" in (dotted(node.func) or "")
+            for node in ast.walk(fn)
+        )
+        if not calls_packer or "tree_bits" in fn.name:
+            continue
+        has_limit = any(
+            isinstance(node, ast.Compare) and any(
+                isinstance(c, ast.Constant) and c.value == 32
+                for c in ast.walk(node)
+            )
+            for node in ast.walk(fn)
+        )
+        if not has_limit:
+            emit(findings, src, RULE, fn,
+                 f"'{fn.name}' packs a tree mask into int32 bitmasks "
+                 f"without a Tq <= 32 limit check — widths past 32 "
+                 f"silently truncate visibility")
+
+
+@lint_pass(RULE)
+def check(src: Source) -> List[Finding]:
+    if not _in_scope(src.path):
+        return []
+    findings: List[Finding] = []
+    _check_index_maps(src, findings)
+    _check_scalar_prefetch(src, findings)
+    _check_tree_bits(src, findings)
+    return findings

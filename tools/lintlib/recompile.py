@@ -1,0 +1,234 @@
+"""recompile-hygiene: jitted program families see only bucketed shapes.
+
+The engine's whole performance story rests on a BOUNDED set of compiled
+programs: chunk/verify widths come from a small pow2 bucket set and
+prompt buckets from ``_bucket``, so occupancy mixtures and prompt-length
+diversity never trigger a recompile mid-serve.  A raw length reaching a
+jitted family compiles one program per distinct value — a silent,
+unbounded compile storm that only shows up as p99 latency.  Checks:
+
+- **bucketed shape variables** (``serving/engine.py``): an assignment to
+  a shape-bucket name (``tq``, ``bucket``) must derive from the bucket
+  helpers (``_bucket`` / ``_chunk_bucket`` / ``_spec_bucket``), an
+  existing array's ``.shape``, integer constants, or ``max``/``min``/
+  ternaries over those — never from a raw prompt length.
+- **module-scope jnp computation** (whole package): a ``jnp.*`` call at
+  module top level allocates on (and can pin) a device at import time,
+  before the CLI configures platforms — and is re-traced by nobody, so
+  it also hides compile cost from every profile.
+- **Python ``if`` on traced values**: inside a function wrapped by
+  ``jax.jit`` in the same module, branching on a (non-static) parameter
+  raises ``TracerBoolConversionError`` at best — and at worst the
+  parameter was *meant* to be static, making every distinct value a new
+  compile.  Trace-time-static tests (``x is None``, ``x.shape``/
+  ``.ndim``/``.dtype``, ``len(x)``, ``isinstance``) are exempt.
+- **unhashable static args**: a list/dict/set display passed to a
+  ``static_argnames`` parameter of a jitted family at a call site dies
+  with ``unhashable type`` on the first call that misses the cache.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lintlib import Finding, Source, dotted, emit, lint_pass
+
+RULE = "recompile-hygiene"
+
+_SHAPE_NAMES = {"tq", "bucket"}
+_BUCKET_FNS = {"_bucket", "_chunk_bucket", "_spec_bucket", "_prompt_bucket"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _pkg(path: str) -> bool:
+    return path.startswith("tree_attention_tpu/")
+
+
+# -- bucketed shape variables ---------------------------------------------
+
+def _bucket_ok(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return True
+    if isinstance(expr, ast.Name) and expr.id in _SHAPE_NAMES:
+        return True  # validated at its own assignment
+    if isinstance(expr, ast.Call):
+        d = dotted(expr.func) or ""
+        if d.split(".")[-1] in _BUCKET_FNS:
+            return True
+        if d in ("max", "min"):
+            return all(_bucket_ok(a) for a in expr.args)
+        return False
+    if isinstance(expr, ast.IfExp):
+        return _bucket_ok(expr.body) and _bucket_ok(expr.orelse)
+    if isinstance(expr, ast.Subscript):
+        return _bucket_ok(expr.value)
+    if isinstance(expr, ast.Attribute):
+        # reading an already-bucketed array's .shape is re-use, not a
+        # fresh raw length
+        return expr.attr in _STATIC_ATTRS
+    return False
+
+
+def _check_shape_vars(src: Source, findings: List[Finding]) -> None:
+    if src.path != "tree_attention_tpu/serving/engine.py":
+        return
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in _SHAPE_NAMES:
+                if not _bucket_ok(node.value):
+                    emit(findings, src, RULE, node,
+                         f"shape variable '{t.id}' assigned from a "
+                         f"non-bucketed expression — raw lengths must "
+                         f"flow through _bucket/_chunk_bucket/"
+                         f"_spec_bucket before reaching a jitted family")
+
+
+# -- module-scope jnp ------------------------------------------------------
+
+def _check_module_jnp(src: Source, findings: List[Finding]) -> None:
+    def scan(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # deferred bodies are fine (class bodies are not)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            if d.startswith("jnp.") or d.startswith("jax.numpy."):
+                emit(findings, src, RULE, node,
+                     f"module-scope {d}(...) computes on device at "
+                     f"import time (move it into the function that "
+                     f"needs it)")
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    for st in src.tree.body:
+        scan(st)
+
+
+# -- Python if on traced values -------------------------------------------
+
+def _jitted_functions(
+    tree: ast.Module,
+) -> List[Tuple[ast.FunctionDef, Set[str]]]:
+    """(function, traced-param-names) for every function jit-wrapped in
+    this module (``jax.jit(fn, ...)`` / ``jax.jit(self._x_fn, ...)``)."""
+    wrapped: Dict[str, Set[str]] = {}  # fn name -> static argnames
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and (dotted(node.func) or "").endswith("jax.jit")
+                and node.args):
+            continue
+        target = dotted(node.args[0])
+        if not target:
+            continue
+        static: Set[str] = set()
+        for kw in node.keywords:
+            if kw.arg == "static_argnames" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                static |= {
+                    el.value for el in kw.value.elts
+                    if isinstance(el, ast.Constant)
+                }
+        wrapped[target.split(".")[-1]] = static
+    out: List[Tuple[ast.FunctionDef, Set[str]]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in wrapped:
+            params = {
+                a.arg for a in (node.args.posonlyargs + node.args.args
+                                + node.args.kwonlyargs)
+                if a.arg != "self"
+            }
+            out.append((node, params - wrapped[node.name]))
+    return out
+
+
+def _static_test(test: ast.AST, traced: Set[str]) -> Optional[ast.Name]:
+    """The first traced-param Name used dynamically in ``test`` (None
+    when every use is trace-time static)."""
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced):
+            continue
+        p = getattr(node, "_lint_parent", None)
+        # x.shape / x.ndim / x.dtype / x.size reads are static
+        if isinstance(p, ast.Attribute) and p.attr in _STATIC_ATTRS:
+            continue
+        # len(x), isinstance(x, T) are static
+        if isinstance(p, ast.Call) and isinstance(p.func, ast.Name) \
+                and p.func.id in ("len", "isinstance"):
+            continue
+        # x is None / x is not None — the tracer object's identity
+        if isinstance(p, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops):
+            continue
+        return node
+    return None
+
+
+def _check_traced_ifs(src: Source, findings: List[Finding]) -> None:
+    for fn, traced in _jitted_functions(src.tree):
+        if not traced:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                bad = _static_test(node.test, traced)
+                if bad is not None:
+                    emit(findings, src, RULE, node,
+                         f"Python branch on traced value '{bad.id}' "
+                         f"inside jitted '{fn.name}' (use lax.cond/"
+                         f"jnp.where, or make the argument static)")
+
+
+# -- unhashable static args -----------------------------------------------
+
+def _check_static_args(src: Source, findings: List[Finding]) -> None:
+    # map: jitted callable name -> its static argnames
+    static_names: Dict[str, Set[str]] = {}
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and (dotted(node.func) or "").endswith("jax.jit")):
+            continue
+        names: Set[str] = set()
+        for kw in node.keywords:
+            if kw.arg == "static_argnames" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                names |= {el.value for el in kw.value.elts
+                          if isinstance(el, ast.Constant)}
+        if not names:
+            continue
+        p = getattr(node, "_lint_parent", None)
+        if isinstance(p, ast.Assign):
+            for t in p.targets:
+                d = dotted(t)
+                if d:
+                    static_names[d.split(".")[-1]] = names
+    if not static_names:
+        return
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        names = static_names.get(d.split(".")[-1])
+        if not names:
+            continue
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)):
+                emit(findings, src, RULE, kw.value,
+                     f"unhashable {type(kw.value).__name__.lower()} "
+                     f"passed for static arg '{kw.arg}' of jitted "
+                     f"'{d}' — every call will fail the jit cache "
+                     f"lookup")
+
+
+@lint_pass(RULE)
+def check(src: Source) -> List[Finding]:
+    if not _pkg(src.path):
+        return []
+    findings: List[Finding] = []
+    _check_shape_vars(src, findings)
+    _check_module_jnp(src, findings)
+    _check_traced_ifs(src, findings)
+    _check_static_args(src, findings)
+    return findings

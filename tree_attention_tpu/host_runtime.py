@@ -72,12 +72,14 @@ def _rank_exit_outcome(status: int) -> str:
 
 
 def _account_gang_result(statuses: Sequence[int]) -> None:
-    if not obs.REGISTRY.enabled:
-        return
-    for s in statuses:
-        _RANK_EXITS.labels(outcome=_rank_exit_outcome(s)).inc()
-    if any(s == 125 for s in statuses):
-        _WATCHDOG_STALLS.inc()
+    if obs.REGISTRY.enabled:
+        for s in statuses:
+            _RANK_EXITS.labels(outcome=_rank_exit_outcome(s)).inc()
+        if any(s == 125 for s in statuses):
+            _WATCHDOG_STALLS.inc()
+    if obs.TRACER.active and any(s == 125 for s in statuses):
+        # Own guard: a tracer-only run used to lose the stall instant to
+        # the registry early-return above.
         obs.instant("watchdog_stall", cat="launcher",
                     args={"statuses": list(statuses)})
 
@@ -611,8 +613,9 @@ def maybe_inject_fault(step: int) -> None:
         except FileNotFoundError:
             return  # already fired on a previous attempt
     log.error("fault injection: rank %d exiting at step %d", rank, step)
-    obs.instant("fault_injection", cat="launcher",
-                args={"rank": rank, "step": step})
+    if obs.TRACER.active:
+        obs.instant("fault_injection", cat="launcher",
+                    args={"rank": rank, "step": step})
     obs.TRACER.flush()  # os._exit skips atexit; don't lose the event
     os._exit(86)
 
@@ -761,8 +764,10 @@ def _launch_elastic(
             "gang attempt %d/%d failed (statuses %s); restarting",
             attempt, restarts + 1, statuses,
         )
-        obs.instant("gang_attempt_failed", cat="launcher",
-                    args={"attempt": attempt, "statuses": list(statuses)})
+        if obs.TRACER.active:
+            obs.instant("gang_attempt_failed", cat="launcher",
+                        args={"attempt": attempt,
+                              "statuses": list(statuses)})
         # Retried attempts' exits must land in the counters too — the
         # caller only accounts the FINAL attempt's statuses, and a stall
         # that elastic recovery papered over is exactly what

@@ -349,10 +349,12 @@ class MetricsRegistry:
         return self._enabled
 
     def enable(self) -> None:
-        self._enabled = True
+        with self._lock:  # cold path; reads stay lock-free via .enabled
+            self._enabled = True
 
     def disable(self) -> None:
-        self._enabled = False
+        with self._lock:
+            self._enabled = False
 
     # -- registration -----------------------------------------------------
 

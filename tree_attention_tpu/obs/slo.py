@@ -202,7 +202,9 @@ class SLOMonitor:
             return
         if now is None:
             now = time.monotonic()
-        if now - self._last_export < self.export_every:
-            return
-        self._last_export = now
+        with self._lock:  # claim the export slot before releasing: two
+            # ticks racing here must not both pay the window sort
+            if now - self._last_export < self.export_every:
+                return
+            self._last_export = now
         self.export_gauges()

@@ -45,7 +45,6 @@ from tree_attention_tpu.ops.block_utils import (
 
 from tree_attention_tpu.ops.block_utils import (
     LANES as _LANES,
-    NEG_INF,
     matmul_precision,
     tpu_compiler_params,
 )

@@ -2030,10 +2030,12 @@ class SlotServer:
                         # its fused (S, 1+Tq) output instead: the token
                         # vector AND every row argmax in the same sync.
                         if all_tok_dev is not None:
+                            # lint: allow[host-sync] THE one per-tick fetch (verify ticks: fused token vector + row argmaxes)
                             fused_host = np.asarray(all_tok_dev)
                             self._tok_host = fused_host[:, 0]
                             alltok_host = fused_host[:, 1:]
                         else:
+                            # lint: allow[host-sync] THE one per-tick fetch (the batched token vector)
                             self._tok_host = np.asarray(self.tok)
                         now2 = time.monotonic()
                         if live_idx:

@@ -53,13 +53,15 @@ def record_guard_verdict(
     - ``clean`` — every screen that ran passed (``reason`` names any
       screen the call site could not run, e.g. jitter needs >= 3 repeats).
     """
-    if not obs.REGISTRY.enabled:
-        return
-    _GUARD_VERDICTS.labels(record=record, guard=guard).inc()
-    args = {"record": record, "guard": guard}
-    if reason:
-        args["reason"] = reason
-    obs.instant("guard_verdict", cat="timing", args=args)
+    if obs.REGISTRY.enabled:
+        _GUARD_VERDICTS.labels(record=record, guard=guard).inc()
+    if obs.TRACER.active:
+        # Each instrument under its own guard: a tracer-only run used to
+        # lose every guard_verdict event to the registry early-return.
+        args = {"record": record, "guard": guard}
+        if reason:
+            args["reason"] = reason
+        obs.instant("guard_verdict", cat="timing", args=args)
 
 
 @dataclasses.dataclass
