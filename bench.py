@@ -662,6 +662,20 @@ def _serving_paged_record():
     return bench_serving_paged_flood()
 
 
+def _serving_ingress_record():
+    """Chaos harness over the live HTTP ingress (ISSUE 10): a heavy-tail
+    timestamped trace replayed against a loopback SSE server — clean
+    baseline, then a disconnect storm + slow readers (survivor streams
+    token-identical, allocator/pin state leak-free), a deadline-heavy
+    overload with shedding+backpressure on vs off (goodput-under-SLO,
+    measured client-side), the 429+Retry-After contract, and a graceful
+    drain. CPU proxy; the robustness structure is the claim. See
+    tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_ingress
+
+    return bench_serving_ingress()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -896,6 +910,7 @@ def _run_suite() -> None:
     run("serving_prefix_flood", _serving_prefix_record)
     run("serving_paged_flood", _serving_paged_record)
     run("serving_speculative", _serving_spec_record)
+    run("serving_ingress_chaos", _serving_ingress_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 

@@ -151,7 +151,7 @@ class TestCLI:
         # Every slot must fit the worst-case prompt plus the full budget.
         assert record["cache_len"] >= 8 + 4 + 4
         assert record["tokens_generated"] == 5 * 4
-        assert record["outcomes"] == {"max_tokens": 5}
+        assert record["outcomes"] == {"budget": 5}
         assert record["tokens_per_sec"] > 0
         assert 0 < record["mean_occupancy"] <= 2
         assert record["p50_s"] <= record["p95_s"]

@@ -25,6 +25,7 @@ ENGINE = "tree_attention_tpu/serving/engine.py"
 OPS_DECODE = "tree_attention_tpu/ops/decode.py"
 PALLAS = "tree_attention_tpu/ops/pallas_decode.py"
 OBS_FLIGHT = "tree_attention_tpu/obs/flight.py"
+INGRESS = "tree_attention_tpu/serving/ingress.py"
 
 
 def run(rule, text, path=ENGINE):
@@ -555,6 +556,44 @@ class TestLockSafety:
             "    def flush(self):\n"
             "        self._x = 1\n"
         ), path=ENGINE)
+        assert fs == []
+
+    def test_ingress_in_scope_unlocked_mutation_flagged(self):
+        # ISSUE 10: the ingress's handler threads share state with the
+        # engine thread — serving/ingress.py joins the lock-safety scope.
+        snippet = (
+            "import threading\n"
+            "class Ingress:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._queued = 0\n"
+            "    def submit(self):\n"
+            "        self._queued += 1\n"
+        )
+        fs = run("lock-safety", snippet, path=INGRESS)
+        assert len(fs) == 1 and "self._queued" in fs[0].message
+        # The engine module itself stays out of scope: handler threads
+        # reach it only through the mailbox seams.
+        assert run("lock-safety", snippet, path=ENGINE) == []
+
+    def test_ingress_locked_mutation_and_condition_lock_clean(self):
+        # The live feeder's Condition doubles as its lock; mutations
+        # under `with self._lock:` pass, and Condition() on a class with
+        # a crash-path method name (close) is not a plain-Lock finding.
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class Feeder:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Condition()\n"
+            "        self._queue = []\n"
+            "        self._closed = False\n"
+            "    def submit(self, r):\n"
+            "        with self._lock:\n"
+            "            self._queue.append(r)\n"
+            "    def close(self):\n"
+            "        with self._lock:\n"
+            "            self._closed = True\n"
+        ), path=INGRESS)
         assert fs == []
 
 

@@ -702,7 +702,7 @@ def test_request_spans_rid_propagation(params, tmp_path):
     assert {e["args"]["rid"] for e in spans} == uids
     for e in spans:
         # Open at admit, closed at retire, outcome + token count tagged.
-        assert e["args"]["outcome"] == "max_tokens"
+        assert e["args"]["outcome"] == "budget"
         assert e["args"]["tokens"] == 4
         assert e["args"]["ttft_s"] >= 0
         assert e["dur"] > 0
@@ -866,7 +866,7 @@ def test_serving_metrics_flow(params):
         )
         done = reg.counter(
             "serving_requests_total", labels=("outcome",)
-        ).labels(outcome="max_tokens").value()
+        ).labels(outcome="budget").value()
         assert done >= 2
         hist = reg.histogram("serving_queue_wait_seconds")
         assert hist._value_payload()["count"] >= 2

@@ -79,6 +79,16 @@ _IGNORE_KEYS = frozenset((
     # evictions vary with trace interleaving, not performance).
     "prefix_len", "prefix_block", "prefix_share", "pool_blocks",
     "pool_blocks_used", "hits", "misses", "evictions", "tokens_reused",
+    # Ingress chaos record (ISSUE 10): arrival/chaos interleaving counts
+    # and calibrated deadlines are workload shape, not performance —
+    # the guarded metrics of that family are goodput_under_slo /
+    # goodput_improvement (larger-is-better ratios) and the latency
+    # keys, which classify through the standard rules.
+    "n_requests", "n_overload", "disconnect_share", "slow_share",
+    "max_queue", "disconnected", "slow_readers", "survivors",
+    "rejected_429", "shed_or_expired", "met", "served", "burst",
+    "interactive_deadline_s", "batch_deadline_s",
+    "makespan_calib_s", "cancelled", "deadline_expired", "shed",
 ))
 
 

@@ -33,8 +33,10 @@ import sys
 # launch tests are conftest-skipped on legacy jaxlib now), 385 after
 # PR 7 (speculative decoding; 386 measured), 441 after PR 8 (invariant
 # linter; 436 measured pre-review + 6 review-fix regression tests in
-# tests/test_lint.py = 442). Raise as PRs add tests.
-FLOOR = 441
+# tests/test_lint.py = 442), 462 after PR 9 (HTTP ingress: cancellation/
+# deadline/drain edges + live loopback SSE tests + lock-safety ingress
+# scope fixtures; 463 measured). Raise as PRs add tests.
+FLOOR = 462
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

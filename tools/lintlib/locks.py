@@ -25,7 +25,14 @@ rules keep that sound:
   emission inside a handler allocates and re-enters emission locks at
   the exact moment they may be held.
 
-Scope: ``tree_attention_tpu/obs/``.
+Scope: ``tree_attention_tpu/obs/`` and — since ISSUE 10 —
+``tree_attention_tpu/serving/ingress.py``: its HTTP handler threads
+share state with the engine thread (queue depth, drain flag, the live
+feeder's queue), and the same mutate-under-``self._lock`` contract
+applies to every ingress class owning one. The engine itself stays out
+of scope by design: handler threads reach it only through the three
+mailbox seams (``submit``/``cancel``/``request_drain``), so all other
+``SlotServer`` state remains single-threaded.
 """
 
 from __future__ import annotations
@@ -51,7 +58,8 @@ _SIGNAL_ROOTS = _CRASH_METHODS | {"_on_term", "_on_usr1"}
 
 
 def _in_scope(path: str) -> bool:
-    return path.startswith("tree_attention_tpu/obs/")
+    return (path.startswith("tree_attention_tpu/obs/")
+            or path == "tree_attention_tpu/serving/ingress.py")
 
 
 def _under_lock(node: ast.AST) -> bool:

@@ -23,6 +23,10 @@ under the matching guard:
 Scope: every module under ``tree_attention_tpu/`` EXCEPT ``obs/`` itself
 (the implementation is where the guards live; its internal early-returns
 use ``self.enabled``, which this pass has no business re-deriving).
+``serving/ingress.py`` (ISSUE 10) is therefore in scope automatically —
+its HTTP route/code counters and queue-depth gauge emit from handler
+threads, where an unguarded label allocation would tax every request
+even with telemetry off.
 """
 
 from __future__ import annotations

@@ -480,6 +480,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             f"--kv-quant {cfg.kv_quant} runs a pallas_decode q8 kernel; "
             f"--impl {cfg.impl} cannot serve a quantized buffer"
         )
+    if cfg.max_queue < 1:
+        raise SystemExit("--max-queue must be >= 1")
+    if cfg.default_deadline is not None and cfg.default_deadline <= 0:
+        raise SystemExit("--default-deadline must be > 0 seconds")
     if cfg.speculate and cfg.temperature != 0.0:
         raise SystemExit(
             "--speculate requires greedy decoding: pass --temperature 0 "
@@ -537,17 +541,6 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
 
     tcfg = _transformer_config(_dc.replace(cfg, seq_len=cache_len))
     params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
-    trace = synthetic_trace(
-        cfg.requests,
-        prompt_len=cfg.prompt_len,
-        prompt_jitter=cfg.prompt_jitter,
-        max_new_tokens=cfg.max_new_tokens,
-        arrival_every=cfg.arrival_every,
-        vocab_size=tcfg.vocab_size,
-        seed=cfg.seed + 1,
-        prefix_share=cfg.prefix_share,
-        prefix_len=cfg.prefix_len,
-    )
     if cfg.slo_ttft <= 0 or cfg.slo_tbt <= 0:
         raise SystemExit("--slo-ttft and --slo-tbt must be > 0")
     # Deprecation shim (ISSUE 6): --prefix-pool-blocks described the OLD
@@ -618,6 +611,63 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     )
     from tree_attention_tpu.host_runtime import heartbeat
 
+    if cfg.serve_http is not None:
+        # The live ingress (ISSUE 10): serve real HTTP traffic until a
+        # drain signal (SIGTERM/SIGINT) winds the engine down; no
+        # synthetic trace — the slot capacity is still sized from
+        # --prompt-len/--prompt-jitter/--max-new-tokens.
+        from tree_attention_tpu.serving.ingress import (
+            IngressServer, install_drain_signals,
+        )
+
+        ingress = IngressServer(
+            server,
+            port=cfg.serve_http,
+            max_queue=cfg.max_queue,
+            default_deadline_s=cfg.default_deadline,
+            default_max_tokens=cfg.max_new_tokens,
+        )
+        install_drain_signals(ingress)
+        port = ingress.start()
+        log.info(
+            "serving HTTP on http://127.0.0.1:%d/v1/completions "
+            "(%d slot(s), cache_len %d, max queue %d%s) — SIGTERM "
+            "drains gracefully",
+            port, cfg.slots, cache_len, cfg.max_queue,
+            f", default deadline {cfg.default_deadline}s"
+            if cfg.default_deadline is not None else "",
+        )
+        heartbeat()
+        report = ingress.join()  # blocks until drained
+        ingress.stop()
+        heartbeat()
+        if report is None:
+            # The engine thread died instead of draining — a crash must
+            # not masquerade as a clean exit.
+            log.error("engine loop crashed: %r", ingress.engine_error)
+            return 1
+        _emit({
+            "mode": "serve",
+            "ingress": {"port": port, "max_queue": cfg.max_queue,
+                        "default_deadline_s": cfg.default_deadline},
+            "slots": cfg.slots,
+            "cache_len": cache_len,
+            "kv_layout": cfg.kv_layout,
+            **(report.as_dict() if report is not None else {}),
+        })
+        return 0
+
+    trace = synthetic_trace(
+        cfg.requests,
+        prompt_len=cfg.prompt_len,
+        prompt_jitter=cfg.prompt_jitter,
+        max_new_tokens=cfg.max_new_tokens,
+        arrival_every=cfg.arrival_every,
+        vocab_size=tcfg.vocab_size,
+        seed=cfg.seed + 1,
+        prefix_share=cfg.prefix_share,
+        prefix_len=cfg.prefix_len,
+    )
     heartbeat()
     report = server.serve(trace)
     heartbeat()
@@ -641,11 +691,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             **({"pool_blocks": prefix_pool_blocks}
                if prefix_pool_blocks is not None else {}),
         }} if cfg.prefix_cache else {}),
+        # Outcome counts ride ServeReport.as_dict (the ISSUE 10 outcome
+        # vocabulary threaded through the report).
         **report.as_dict(),
-        "outcomes": {
-            o: sum(1 for r in report.results if r.outcome == o)
-            for o in sorted({r.outcome for r in report.results})
-        },
         **({"kv_quant": cfg.kv_quant} if cfg.kv_quant != "none" else {}),
     })
     return 0

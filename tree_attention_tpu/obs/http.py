@@ -19,27 +19,27 @@ Scrapes hold the registry lock only for the duration of one snapshot —
 the same cost an exit dump pays; the engine's disabled-path contract is
 untouched (the server only *reads*).
 
-Bind: localhost by default (telemetry is not an open service); pass
-``host="0.0.0.0"`` explicitly to expose it. ``port=0`` lets the OS pick —
-tests and parallel bench runs use that; :attr:`port` reports the bound
-port after :meth:`start`.
+Server lifecycle (daemon thread, localhost bind, ``port=0`` OS-pick) is
+the shared :class:`~tree_attention_tpu.utils.httpd.DaemonHTTPServer`
+plumbing — the serving ingress rides the identical base.
 """
 
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from http.server import BaseHTTPRequestHandler
 
 from tree_attention_tpu.obs.flight import FLIGHT, FlightRecorder
 from tree_attention_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from tree_attention_tpu.utils.httpd import DaemonHTTPServer
 
 DEFAULT_STALL_AFTER = 60.0
 
 
-class MetricsHTTPServer:
+class MetricsHTTPServer(DaemonHTTPServer):
     """Daemon-thread HTTP exporter over one registry + flight recorder."""
+
+    thread_name = "obs-http"
 
     def __init__(
         self,
@@ -50,91 +50,47 @@ class MetricsHTTPServer:
         flight: FlightRecorder = FLIGHT,
         stall_after: float = DEFAULT_STALL_AFTER,
     ):
-        self._host = host
-        self._want_port = port
+        super().__init__(port, host)
         self._registry = registry
         self._flight = flight
         self._stall_after = stall_after
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-
-    # -- lifecycle --------------------------------------------------------
-
-    def start(self) -> int:
-        """Bind and serve on a daemon thread; returns the bound port."""
-        if self._httpd is not None:
-            return self.port
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):  # no stderr per scrape
-                pass
-
-            def do_GET(self):
-                try:
-                    server._handle(self)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass  # scraper went away mid-reply
-
-        self._httpd = ThreadingHTTPServer(
-            (self._host, self._want_port), Handler
-        )
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="obs-http",
-            daemon=True,
-        )
-        self._thread.start()
-        return self.port
-
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            self._thread = None
-
-    @property
-    def port(self) -> int:
-        return 0 if self._httpd is None else self._httpd.server_address[1]
-
-    @property
-    def running(self) -> bool:
-        return self._httpd is not None
 
     # -- endpoints --------------------------------------------------------
 
-    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+    def handle(self, method: str, req: BaseHTTPRequestHandler) -> None:
+        if method != "GET":
+            self.reply(req, 405, "metrics endpoint is read-only\n",
+                       "text/plain")
+            return
         path = req.path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/metrics":
-            self._reply(
+            self.reply(
                 req, 200, self._registry.to_prometheus(),
                 "text/plain; version=0.0.4; charset=utf-8",
             )
         elif path == "/metrics.json":
-            self._reply(req, 200, self._registry.to_json(indent=2),
-                        "application/json")
+            self.reply(req, 200, self._registry.to_json(indent=2),
+                       "application/json")
         elif path == "/healthz":
             code, body = self._healthz()
-            self._reply(req, code, json.dumps(body, indent=2),
-                        "application/json")
+            self.reply(req, code, json.dumps(body, indent=2),
+                       "application/json")
         elif path == "/flight":
-            self._reply(
+            self.reply(
                 req, 200,
                 json.dumps(self._flight.snapshot(), indent=2, default=str),
                 "application/json",
             )
         elif path == "/":
-            self._reply(
+            self.reply(
                 req, 200,
                 "tree_attention_tpu telemetry: /metrics /metrics.json "
                 "/healthz /flight\n",
                 "text/plain",
             )
         else:
-            self._reply(req, 404, f"no such endpoint: {path}\n",
-                        "text/plain")
+            self.reply(req, 404, f"no such endpoint: {path}\n",
+                       "text/plain")
 
     def _healthz(self):
         age = self._flight.last_tick_age()
@@ -154,13 +110,3 @@ class MetricsHTTPServer:
             return 200, body
         body["status"] = "stalled"
         return 503, body
-
-    @staticmethod
-    def _reply(req: BaseHTTPRequestHandler, code: int, body: str,
-               ctype: str) -> None:
-        data = body.encode("utf-8")
-        req.send_response(code)
-        req.send_header("Content-Type", ctype)
-        req.send_header("Content-Length", str(len(data)))
-        req.end_headers()
-        req.wfile.write(data)

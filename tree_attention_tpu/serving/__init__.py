@@ -10,10 +10,13 @@ carry the raggedness as data, not shape).
 """
 
 from tree_attention_tpu.serving.engine import (  # noqa: F401
+    OUTCOMES,
     Request,
     RequestResult,
+    RequestSource,
     ServeReport,
     SlotServer,
+    StaticRequestSource,
     synthetic_trace,
 )
 from tree_attention_tpu.serving.block_pool import BlockAllocator  # noqa: F401

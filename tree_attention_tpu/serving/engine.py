@@ -93,6 +93,22 @@ Variants:
   (``tests/test_serving_spec.py`` pins it across exact/int8 ×
   chunked/whole × device/mesh).
 
+- **Robustness lifecycle** (ISSUE 10): ``serve()`` takes a pre-built
+  trace OR a live :class:`RequestSource`; each tick starts with a
+  control sweep applying thread-safe mailboxes — :meth:`SlotServer
+  .cancel` (client disconnect: retire mid-flight, release prefix pins,
+  unmap paged blocks back to the pool — cancellation is cheap by
+  construction under the paged layout), per-request deadlines
+  (expired-in-queue rejected unserved, expired-in-flight retired with
+  outcome ``deadline``), and :meth:`SlotServer.request_drain` (SIGTERM:
+  stop admitting, shed the queue, finish in-flight). Every exit arc
+  speaks the closed :data:`OUTCOMES` vocabulary
+  (``eos|budget|cancelled|deadline|shed|error``), threaded through
+  ``serving_requests_total{outcome}``, span args, flight fields, and
+  ``ServeReport.outcomes``; :meth:`SlotServer.leak_report` states the
+  no-leak invariant the chaos harness asserts. The HTTP front door
+  lives in :mod:`~tree_attention_tpu.serving.ingress`.
+
 Works on one device and on a sequence-sharded mesh (the contiguous cache
 is seq-sharded and rides the tree merge; the paged pool is replicated —
 block offsets cannot stay aligned with a sequence shard — and rides the
@@ -102,9 +118,12 @@ flash/Pallas paths).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import (
+    Any, Callable, Dict, List, Optional, Sequence, Set, Tuple, Union,
+)
 
 import jax
 import jax.numpy as jnp
@@ -193,6 +212,21 @@ _SPEC_ACCEPT_RATIO = obs.gauge(
 )
 
 
+# The ONE retire-outcome vocabulary (ISSUE 10): every way a request can
+# leave the engine, threaded unchanged through
+# ``serving_requests_total{outcome}``, the per-request span args, and
+# ``ServeReport.outcomes`` — a new exit path must add its name here, not
+# stringly-type its way in.
+OUTCOME_EOS = "eos"              # sampled the request's eos_id
+OUTCOME_BUDGET = "budget"        # hit max_new_tokens
+OUTCOME_CANCELLED = "cancelled"  # client cancelled (disconnect) mid-flight
+OUTCOME_DEADLINE = "deadline"    # per-request deadline expired
+OUTCOME_SHED = "shed"            # dropped unserved (drain / load shedding)
+OUTCOME_ERROR = "error"          # live-submitted request failed validation
+OUTCOMES = (OUTCOME_EOS, OUTCOME_BUDGET, OUTCOME_CANCELLED,
+            OUTCOME_DEADLINE, OUTCOME_SHED, OUTCOME_ERROR)
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request for the serving loop.
@@ -201,6 +235,22 @@ class Request:
     becomes visible to the scheduler once the loop's tick counter reaches
     it (0 = already queued at start). ``eos_id`` stops generation early
     when sampled (the EOS token is included in the output).
+
+    The ingress-facing fields (ISSUE 10) all default off:
+
+    - ``deadline_s`` — absolute ``time.monotonic()`` deadline; expired in
+      queue the request is rejected unserved, expired in flight it is
+      retired with outcome ``deadline`` (work that can no longer meet its
+      SLO is shed, not finished late).
+    - ``on_token`` / ``on_finish`` — per-request streaming callbacks,
+      invoked ON THE ENGINE THREAD as tokens commit / at retire; they
+      must hand off fast (the ingress pushes into per-request queues)
+      and never raise (a raising callback is logged and dropped, the
+      request keeps running).
+    - ``visible_at`` — wall-clock visibility override set by live
+      sources at submission, so queue-wait/TTFT clocks start when the
+      client's request entered the system, not when the loop first saw
+      it.
     """
 
     uid: int
@@ -208,6 +258,10 @@ class Request:
     max_new_tokens: int
     arrival_tick: int = 0
     eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
+    on_token: Optional[Callable[[int], None]] = None
+    on_finish: Optional[Callable[["RequestResult"], None]] = None
+    visible_at: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -216,11 +270,11 @@ class RequestResult:
     tokens: List[int]
     prompt_len: int
     arrival_tick: int
-    admit_tick: int
+    admit_tick: int  # -1: never admitted (cancelled/expired/shed in queue)
     finish_tick: int
     queue_wait_s: float
     completion_s: float  # visible -> finished, wall seconds
-    outcome: str  # "eos" | "max_tokens"
+    outcome: str  # one of OUTCOMES
     ttft_s: float = 0.0  # visible -> first sampled token, wall seconds
 
 
@@ -249,6 +303,15 @@ class ServeReport:
     def tokens_per_sec(self) -> float:
         return self.tokens_generated / self.wall_s if self.wall_s > 0 else 0.0
 
+    @property
+    def outcomes(self) -> Dict[str, int]:
+        """Retire-outcome counts over the run (the OUTCOMES vocabulary;
+        only outcomes that occurred appear)."""
+        out: Dict[str, int] = {}
+        for r in self.results:
+            out[r.outcome] = out.get(r.outcome, 0) + 1
+        return {k: out[k] for k in sorted(out)}
+
     def completion_percentiles(self) -> Dict[str, float]:
         cs = sorted(r.completion_s for r in self.results)
         return {"p50_s": percentile(cs, 0.50), "p95_s": percentile(cs, 0.95)}
@@ -256,8 +319,11 @@ class ServeReport:
     def latency_percentiles(self) -> Dict[str, float]:
         """TTFT (visible -> first token) and inter-token latency (gap
         between consecutive tokens of one slot, pooled over slots) — the
-        two serving latencies chunked prefill exists to protect."""
-        ttft = sorted(r.ttft_s for r in self.results)
+        two serving latencies chunked prefill exists to protect. Requests
+        that never produced a token (cancelled/expired/shed unserved)
+        have no TTFT and are excluded rather than skewing the
+        distribution toward 0."""
+        ttft = sorted(r.ttft_s for r in self.results if r.tokens)
         tbt = sorted(self.tbt_s)
         return {
             "ttft_p50_s": percentile(ttft, 0.50),
@@ -276,6 +342,7 @@ class ServeReport:
             "tokens_per_sec": round(self.tokens_per_sec, 1),
             "mean_occupancy": round(self.mean_occupancy, 2),
             "queue_wait_p50_s": round(waits[len(waits) // 2], 4) if waits else 0.0,
+            "outcomes": self.outcomes,
             **{k: round(v, 4) for k, v in self.completion_percentiles().items()},
             **{k: round(v, 5) for k, v in self.latency_percentiles().items()},
             **({"slo": self.slo} if self.slo else {}),
@@ -349,6 +416,75 @@ def synthetic_trace(
             eos_id=eos_id,
         ))
     return reqs
+
+
+class RequestSource:
+    """Where the tick loop gets its work (ISSUE 10).
+
+    ``serve()`` used to eat a pre-built request list; a real ingress
+    feeds requests as clients produce them. This is the seam: the loop
+    calls :meth:`poll` once per tick for newly visible requests,
+    :meth:`next_arrival` to fast-forward synthetic time across idle
+    gaps, :meth:`wait` to block briefly when a live feeder has nothing
+    yet, and :meth:`close` when draining. The base class is an empty,
+    already-exhausted source; :class:`StaticRequestSource` wraps the
+    legacy list, and the ingress's ``QueueRequestSource``
+    (:mod:`~tree_attention_tpu.serving.ingress`) is the thread-safe
+    live feeder.
+    """
+
+    def poll(self, tick: int) -> List[Request]:
+        """Requests that became visible by ``tick`` (each returned
+        exactly once)."""
+        return []
+
+    def next_arrival(self) -> Optional[int]:
+        """The next future arrival tick (synthetic sources only), or
+        None when arrivals are wall-clock driven or exhausted."""
+        return None
+
+    def wait(self, timeout: float) -> bool:
+        """Block up to ``timeout`` seconds for new work (live feeders);
+        returns True if work may be available. Synthetic sources return
+        False immediately — the loop fast-forwards instead of sleeping."""
+        return False
+
+    def close(self) -> None:
+        """Stop accepting/producing new requests (graceful drain)."""
+
+    @property
+    def exhausted(self) -> bool:
+        """True when no request will ever be returned again."""
+        return True
+
+
+class StaticRequestSource(RequestSource):
+    """The legacy shape: a fixed trace, visible by ``arrival_tick``."""
+
+    def __init__(self, requests: Sequence[Request]):
+        self._reqs = sorted(requests,
+                            key=lambda r: (r.arrival_tick, r.uid))
+        self._pos = 0
+
+    def poll(self, tick: int) -> List[Request]:
+        out: List[Request] = []
+        while (self._pos < len(self._reqs)
+               and self._reqs[self._pos].arrival_tick <= tick):
+            out.append(self._reqs[self._pos])
+            self._pos += 1
+        return out
+
+    def next_arrival(self) -> Optional[int]:
+        if self._pos >= len(self._reqs):
+            return None
+        return self._reqs[self._pos].arrival_tick
+
+    def close(self) -> None:
+        self._pos = len(self._reqs)  # drop the rest: nothing more arrives
+
+    @property
+    def exhausted(self) -> bool:
+        return self._pos >= len(self._reqs)
 
 
 def _bucket(n: int, cap: int, floor: int = 8, multiple: int = 1) -> int:
@@ -611,7 +747,21 @@ class SlotServer:
         self._prompt_np: List[Optional[np.ndarray]] = [None] * slots
         self._prefill_fifo: List[int] = []  # prefilling slots, admit order
         self._last_tok_t: List[float] = [0.0] * slots
+        self._slot_wait: List[float] = [0.0] * slots
         self._tok_host = np.zeros((slots,), np.int32)
+
+        # Thread-safe control mailboxes (ISSUE 10): ingress handler
+        # threads only ever touch these two under the control lock —
+        # cancel() records a uid, request_drain() raises the flag — and
+        # the tick loop sweeps both at tick start, so every actual
+        # engine/state mutation stays on the loop thread.
+        self._ctl_lock = threading.Lock()
+        self._cancel_uids: Set[int] = set()
+        self._draining = False
+        # Per-tick robustness accounting for the flight recorder.
+        self._tick_cancelled = 0
+        self._tick_deadline = 0
+        self._tick_shed = 0
 
         # Observability plane (PR 4): a per-request span held open from
         # admit to retire (None while the slot is free / tracing is off),
@@ -1043,6 +1193,123 @@ class SlotServer:
                                                   axis=0)
         return staging, new_cache, tok_vec
 
+    # -- ingress-facing control (thread-safe) ------------------------------
+
+    def cancel(self, uid: int) -> None:
+        """Cancel request ``uid`` (any thread; e.g. a client disconnect).
+
+        Records the uid in the control mailbox; the tick loop's sweep
+        applies it at the next tick start — queued-unadmitted requests
+        finish unserved, in-flight requests retire mid-stream (slot
+        freed, prefix pins released, paged blocks unmapped back to the
+        pool). Unknown/already-finished uids are a no-op (the client
+        may disconnect after its stream completed)."""
+        with self._ctl_lock:
+            self._cancel_uids.add(uid)
+
+    def request_drain(self) -> None:
+        """Begin graceful drain (any thread; e.g. a SIGTERM handler).
+
+        The loop stops admitting: visible-but-unadmitted work is shed
+        (outcome ``shed``), the source is closed, in-flight requests run
+        to completion, and ``serve()`` returns — the caller then flushes
+        telemetry and exits."""
+        with self._ctl_lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        with self._ctl_lock:
+            return self._draining
+
+    @property
+    def all_slots_free(self) -> bool:
+        """True when no request occupies a slot (single list read —
+        safe to poll from harness/monitor threads)."""
+        return all(st == "free" for st in self._slot_state)
+
+    def _take_control(self) -> Tuple[Set[int], bool]:
+        """Drain the cancel mailbox and read the drain flag (loop side)."""
+        with self._ctl_lock:
+            cancels = self._cancel_uids
+            self._cancel_uids = set()
+            return cancels, self._draining
+
+    def leak_report(self) -> Dict[str, int]:
+        """The no-leak invariant, as numbers (chaos-harness contract).
+
+        After a drained run — every request retired, however it exited —
+        the engine must hold NO per-request resources: no slot-private
+        blocks, no unspent reservations, no pinned radix nodes; the only
+        legitimate pool occupancy is the radix tree's retained cache
+        (``blocks_used == blocks_cached``). A disconnect storm that
+        violates this leaked memory."""
+        out = {
+            "blocks_private": (sum(len(s) for s in self._slot_private)
+                               if self._paged else 0),
+            "blocks_used": self._pool.used if self._paged else 0,
+            "blocks_reserved": self._pool.reserved if self._paged else 0,
+            "blocks_cached": 0,
+            "pins": 0,
+        }
+        if self._prefix is not None:
+            out["blocks_cached"] = self._prefix.blocks_used
+            out["pins"] = self._prefix.total_pins()
+        elif self._paged:
+            # No prefix tree: every used block is slot-private, so a
+            # drained engine must be at used == 0 exactly.
+            pass
+        return out
+
+    # -- per-request callbacks (engine thread) -----------------------------
+
+    def _push_token(self, req: Request, tok: int) -> None:
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception:
+                log.exception("on_token callback failed (rid %s)", req.uid)
+
+    def _notify_finish(self, req: Request, result: RequestResult) -> None:
+        if req.on_finish is not None:
+            try:
+                req.on_finish(result)
+            except Exception:
+                log.exception("on_finish callback failed (rid %s)", req.uid)
+
+    def _finish_unadmitted(self, req: Request, tick: int, outcome: str,
+                           results: List[RequestResult],
+                           visible_at: float, now: float) -> None:
+        """Retire a request that never reached a slot (cancelled,
+        deadline-expired, or shed while queued; invalid live
+        submission). No engine resources to release — only the result,
+        the outcome counter, and the client callback."""
+        res = RequestResult(
+            uid=req.uid,
+            tokens=[],
+            prompt_len=len(req.prompt),
+            arrival_tick=req.arrival_tick,
+            admit_tick=-1,
+            finish_tick=tick,
+            queue_wait_s=max(now - visible_at, 0.0),
+            completion_s=max(now - visible_at, 0.0),
+            outcome=outcome,
+            ttft_s=0.0,
+        )
+        results.append(res)
+        if outcome in (OUTCOME_DEADLINE, OUTCOME_SHED, OUTCOME_ERROR):
+            # A categorical SLO miss: the system failed to serve it.
+            # (Client cancellations are not the server's miss.)
+            self.slo.observe_miss()
+        if obs.REGISTRY.enabled:
+            _REQUESTS.labels(outcome=outcome).inc()
+        if obs.TRACER.active:
+            obs.instant("request_rejected", cat="serving", args={
+                "rid": req.uid, "tick": tick, "outcome": outcome,
+                "queued_s": round(res.queue_wait_s, 6),
+            })
+        self._notify_finish(req, res)
+
     # -- scheduler --------------------------------------------------------
 
     def _free_slots(self) -> List[int]:
@@ -1145,6 +1412,10 @@ class SlotServer:
         self._slot_tokens[slot] = []
         self._slot_admit[slot] = (tick, visible_at)
         self._slot_max_tbt[slot] = 0.0
+        self._slot_ttft[slot] = 0.0  # stale-occupant guard: a request
+        # retired before its first token must report ttft 0, not the
+        # previous occupant's
+        self._slot_wait[slot] = waited
         self._chunk_k[slot] = 0
         self.slo.observe_queue_wait(waited)
         # Prefix reuse happens FIRST: the matched length decides how much
@@ -1501,13 +1772,13 @@ class SlotServer:
                 for j, t in enumerate(emit_list):
                     if t == req.eos_id:
                         emit_list = emit_list[:j + 1]
-                        outcome = "eos"
+                        outcome = OUTCOME_EOS
                         break
             n_emit = len(emit_list)
             if outcome is None and (
                 len(self._slot_tokens[i]) + n_emit >= req.max_new_tokens
             ):
-                outcome = "max_tokens"
+                outcome = OUTCOME_BUDGET
             # The burst lands at one instant: the first token carries the
             # whole inter-token gap, the rest arrive for free — the
             # honest latency shape of speculative decode.
@@ -1520,6 +1791,7 @@ class SlotServer:
             for j, t in enumerate(emit_list):
                 self._slot_tokens[i].append(int(t))
                 self._hist_buf[i, hl + j] = int(t)
+                self._push_token(req, int(t))
                 tbt.append(gap if j == 0 else 0.0)
                 if obs.REGISTRY.enabled:
                     _TOKENS.inc()
@@ -1647,24 +1919,42 @@ class SlotServer:
 
     def _retire(self, slot: int, tick: int, outcome: str,
                 results: List[RequestResult]) -> None:
+        """Free a slot on ANY outcome arc. The happy paths (eos/budget)
+        and the robustness paths (cancelled/deadline) release the exact
+        same resources — prefix pins, private paged blocks, unspent
+        reservations — so retiring a request mid-prefill or mid-stream
+        is just this, earlier (cancellation is cheap by construction:
+        PagedAttention's unmap, arXiv:2309.06180)."""
         req = self._slot_req[slot]
         admit_tick, visible_at = self._slot_admit[slot]
         now = time.monotonic()
-        results.append(RequestResult(
+        result = RequestResult(
             uid=req.uid,
             tokens=list(self._slot_tokens[slot]),
             prompt_len=len(req.prompt),
             arrival_tick=req.arrival_tick,
             admit_tick=admit_tick,
             finish_tick=tick,
-            queue_wait_s=0.0,  # filled by serve() from its visible ledger
+            queue_wait_s=self._slot_wait[slot],
             completion_s=max(now - visible_at, 0.0),
             outcome=outcome,
             ttft_s=self._slot_ttft[slot],
-        ))
-        self.slo.observe_request(
-            self._slot_ttft[slot], self._slot_max_tbt[slot]
         )
+        results.append(result)
+        if outcome in (OUTCOME_EOS, OUTCOME_BUDGET):
+            self.slo.observe_request(
+                self._slot_ttft[slot], self._slot_max_tbt[slot]
+            )
+        elif outcome in (OUTCOME_DEADLINE, OUTCOME_SHED, OUTCOME_ERROR):
+            # The server failed this request; a client cancellation
+            # (the remaining arc) is not the server's SLO miss.
+            self.slo.observe_miss()
+        if slot in self._prefill_fifo:
+            # Cancelled/expired mid-prefill: leave the chunk plan (and,
+            # under int8, release the one-prompt-at-a-time staging
+            # latch — the staged rows are garbage the next admission's
+            # first-chunk reset overwrites).
+            self._prefill_fifo.remove(slot)
         span = self._slot_span[slot]
         if span is not None:
             if obs.TRACER.active:
@@ -1706,20 +1996,45 @@ class SlotServer:
             self._pool.gen += 1
         if obs.REGISTRY.enabled:
             _REQUESTS.labels(outcome=outcome).inc()
+        self._notify_finish(req, result)
 
-    def serve(self, requests: Sequence[Request],
+    def serve(self, requests: Union[Sequence[Request], RequestSource],
               max_ticks: Optional[int] = None) -> ServeReport:
-        """Run the tick loop until every request has finished.
+        """Run the tick loop until the request source drains.
 
-        Requests are admitted in arrival order (FIFO per arrival tick);
-        ``max_ticks`` bounds runaway loops (raises if work remains)."""
-        for r in requests:
-            self._validate(r)
-        pending = deque(sorted(requests, key=lambda r: (r.arrival_tick, r.uid)))
-        results: List[RequestResult] = []
+        ``requests`` is a pre-built trace (the legacy shape — admitted in
+        arrival order, FIFO per arrival tick, every request validated up
+        front) or a live :class:`RequestSource` (the ingress shape —
+        requests appear as clients submit them, invalid ones finish with
+        outcome ``error`` instead of raising, and the loop idles on
+        :meth:`RequestSource.wait` between arrivals). Each tick starts
+        with the control sweep: mailboxed cancellations apply, expired
+        deadlines shed their requests, and a requested drain stops
+        admission and sheds the queue. ``max_ticks`` bounds runaway loops
+        (raises if work remains)."""
+        live = isinstance(requests, RequestSource)
+        if live:
+            source: RequestSource = requests
+        else:
+            for r in requests:
+                self._validate(r)
+            source = StaticRequestSource(requests)
+            with self._ctl_lock:
+                # A previous run's stale mailbox must not cancel or
+                # drain this fresh synthetic trace (uids recycle). Live
+                # sources deliberately SKIP this reset: a drain or
+                # cancel issued between spawning the engine thread and
+                # the loop starting must be honored, not wiped.
+                self._cancel_uids.clear()
+                self._draining = False
+        pending: deque = deque()  # visible, validated, unadmitted
+        cancel_carry: Dict[int, int] = {}  # unmatched cancels, sweep TTL
+        # A live server runs indefinitely: bound its retention (the
+        # report then covers the most recent window) — a finite trace
+        # keeps everything, as before.
+        results: Any = deque(maxlen=4096) if live else []
         visible_wall: Dict[int, float] = {}
-        wait_ledger: Dict[int, float] = {}
-        tbt: List[float] = []
+        tbt: Any = deque(maxlen=1 << 16) if live else []
         tick = 0
         decode_ticks = 0
         occupancy = 0
@@ -1734,7 +2049,7 @@ class SlotServer:
         t0 = time.monotonic()
 
         try:
-            while pending or any(st != "free" for st in self._slot_state):
+            while True:
                 if max_ticks is not None and tick >= max_ticks:
                     raise RuntimeError(
                         f"serve() exceeded max_ticks={max_ticks} with "
@@ -1744,23 +2059,109 @@ class SlotServer:
                 self._tick_prefix_hits = 0
                 self._tick_prefix_reused = 0
                 self._tick_spec = (0, 0, 0)
-                visible = 0
-                for r in pending:  # sorted by arrival_tick — stop at future
-                    if r.arrival_tick > tick:
-                        break
-                    visible += 1
-                    if r.uid not in visible_wall:
-                        visible_wall[r.uid] = now
-                        if obs.TRACER.active:
-                            obs.instant("request_queued", cat="serving",
-                                        args={"rid": r.uid, "tick": tick})
+                self._tick_cancelled = 0
+                self._tick_deadline = 0
+                self._tick_shed = 0
+
+                # Ingest newly visible requests. A live source's invalid
+                # request must not kill the loop serving everyone else —
+                # it finishes unserved with outcome 'error' (static
+                # traces were validated up front and still raise).
+                for r in source.poll(tick):
+                    vis = r.visible_at if r.visible_at is not None else now
+                    try:
+                        self._validate(r)
+                    except ValueError as e:
+                        log.warning("rejecting request %s: %s", r.uid, e)
+                        self._finish_unadmitted(
+                            r, tick, OUTCOME_ERROR, results, vis, now
+                        )
+                        continue
+                    pending.append(r)
+                    visible_wall[r.uid] = vis
+                    if obs.TRACER.active:
+                        obs.instant("request_queued", cat="serving",
+                                    args={"rid": r.uid, "tick": tick})
+
+                # Control sweep (ISSUE 10): mailboxed cancellations,
+                # expired deadlines, drain — applied at tick start so
+                # every mutation stays on the loop thread. Order within
+                # the sweep: cancellation beats deadline beats drain-shed
+                # (a disconnected client's request is 'cancelled' even if
+                # its deadline also just expired); EOS/budget from the
+                # PREVIOUS tick already retired, so a request finishing
+                # and expiring on the same tick keeps its happy outcome.
+                cancels, draining = self._take_control()
+                cancels |= set(cancel_carry)
+                if cancels:
+                    matched = set()
+                    for r in [r for r in pending if r.uid in cancels]:
+                        pending.remove(r)
+                        matched.add(r.uid)
+                        self._tick_cancelled += 1
+                        self._finish_unadmitted(
+                            r, tick, OUTCOME_CANCELLED, results,
+                            visible_wall.pop(r.uid, now), now,
+                        )
+                    for i, rq in enumerate(self._slot_req):
+                        if rq is not None and rq.uid in cancels:
+                            matched.add(rq.uid)
+                            self._tick_cancelled += 1
+                            self._retire(i, tick, OUTCOME_CANCELLED,
+                                         results)
+                    # A cancel can race its own request's submission: the
+                    # handler's submit may land AFTER this tick's poll
+                    # while the cancel lands BEFORE this sweep. Carry
+                    # unmatched uids for a couple of sweeps so the
+                    # request is caught the moment it is ingested;
+                    # genuinely unknown/finished uids age out as no-ops.
+                    for uid in cancels - matched:
+                        if uid not in cancel_carry:
+                            cancel_carry[uid] = 2
+                        else:
+                            cancel_carry[uid] -= 1
+                            if cancel_carry[uid] <= 0:
+                                del cancel_carry[uid]
+                    for uid in matched:
+                        cancel_carry.pop(uid, None)
+                for r in [r for r in pending
+                          if r.deadline_s is not None
+                          and now >= r.deadline_s]:
+                    # Expired in queue: reject unserved — admitting work
+                    # that can no longer meet its deadline only steals
+                    # tick budget from requests that still can.
+                    pending.remove(r)
+                    self._tick_deadline += 1
+                    self._finish_unadmitted(
+                        r, tick, OUTCOME_DEADLINE, results,
+                        visible_wall.pop(r.uid, now), now,
+                    )
+                for i, rq in enumerate(self._slot_req):
+                    if (rq is not None and rq.deadline_s is not None
+                            and now >= rq.deadline_s):
+                        # Expired in flight: retire mid-stream; the
+                        # partial tokens already streamed stand.
+                        self._tick_deadline += 1
+                        self._retire(i, tick, OUTCOME_DEADLINE, results)
+                if draining:
+                    # Graceful drain: close the source, shed everything
+                    # still queued, keep stepping the in-flight slots to
+                    # completion.
+                    source.close()
+                    while pending:
+                        r = pending.popleft()
+                        self._tick_shed += 1
+                        self._finish_unadmitted(
+                            r, tick, OUTCOME_SHED, results,
+                            visible_wall.pop(r.uid, now), now,
+                        )
 
                 # Admit: oldest visible request per free slot. Chunked
                 # admission is pure bookkeeping (the chunks run inside the
                 # tick); the staged (quantized) variant holds one prompt in
                 # flight at a time, so admission waits for the stage.
                 free = self._free_slots()
-                while free and pending and pending[0].arrival_tick <= tick:
+                while free and pending:
                     if self._staged_prefill and self._prefill_fifo:
                         break
                     resv = None
@@ -1783,11 +2184,29 @@ class SlotServer:
                             break
                     req = pending.popleft()
                     slot = free.pop(0)
-                    visible -= 1
-                    vis = visible_wall.setdefault(req.uid, now)
-                    wait_ledger[req.uid] = self._admit(req, slot, tick,
-                                                       vis, resv)
-                queue_depth = visible  # visible but still unadmitted
+                    vis = visible_wall.pop(req.uid, now)
+                    self._admit(req, slot, tick, vis, resv)
+                queue_depth = len(pending)  # visible but still unadmitted
+
+                if not pending and all(st == "free"
+                                       for st in self._slot_state):
+                    # Nothing to do this tick. Drained (source exhausted
+                    # or draining): done. Synthetic trace: fast-forward
+                    # to the next arrival instead of spinning empty
+                    # decode ticks. Live feeder: report idle (the
+                    # /healthz contract — an idle server is not a
+                    # stalled one) and block briefly for submissions
+                    # (wakes early on submit/close).
+                    if source.exhausted or draining:
+                        break
+                    nxt = source.next_arrival()
+                    if nxt is not None:
+                        tick = max(tick + 1, nxt)
+                    else:
+                        if FLIGHT.enabled:
+                            FLIGHT.mark_idle()
+                        source.wait(0.05)
+                    continue
 
                 # Plan this tick's prefill chunks (chunked admission only).
                 plan = (self._plan_chunks()
@@ -2045,6 +2464,7 @@ class SlotServer:
                             req = self._slot_req[i]
                             first = int(self._tok_host[i])
                             self._slot_tokens[i] = [first]
+                            self._push_token(req, first)
                             self._slot_state[i] = "live"
                             # Committed cache rows = the prompt; the
                             # first token is the pending tip (spec mode's
@@ -2057,6 +2477,7 @@ class SlotServer:
                             _, vis = self._slot_admit[i]
                             self._slot_ttft[i] = max(now2 - vis, 0.0)
                             self._last_tok_t[i] = now2
+                            tokens += 1  # the prefill-sampled first token
                             tokens_this_tick += 1
                             self.slo.observe_ttft(self._slot_ttft[i])
                             if obs.REGISTRY.enabled:
@@ -2072,9 +2493,9 @@ class SlotServer:
                                     })
                             if req.eos_id is not None \
                                     and first == req.eos_id:
-                                self._retire(i, tick, "eos", results)
+                                self._retire(i, tick, OUTCOME_EOS, results)
                             elif req.max_new_tokens <= 1:
-                                self._retire(i, tick, "max_tokens",
+                                self._retire(i, tick, OUTCOME_BUDGET,
                                              results)
                         if self._speculate:
                             # Spec mode: live-slot tokens come from the
@@ -2092,6 +2513,7 @@ class SlotServer:
                                 req = self._slot_req[i]
                                 tok_i = int(self._tok_host[i])
                                 self._slot_tokens[i].append(tok_i)
+                                self._push_token(req, tok_i)
                                 tokens += 1
                                 tokens_this_tick += 1
                                 gap = max(now2 - self._last_tok_t[i], 0.0)
@@ -2105,10 +2527,11 @@ class SlotServer:
                                     _TBT.observe(gap)
                                 if req.eos_id is not None \
                                         and tok_i == req.eos_id:
-                                    self._retire(i, tick, "eos", results)
+                                    self._retire(i, tick, OUTCOME_EOS,
+                                                 results)
                                 elif (len(self._slot_tokens[i])
                                         >= req.max_new_tokens):
-                                    self._retire(i, tick, "max_tokens",
+                                    self._retire(i, tick, OUTCOME_BUDGET,
                                                  results)
                     if obs.TRACER.active:
                         tick_span.set(host_sync=host_sync,
@@ -2140,6 +2563,13 @@ class SlotServer:
                         "pending": len(pending),
                         "prefix_hits": self._tick_prefix_hits,
                         "prefix_reused": self._tick_prefix_reused,
+                        # Robustness arcs this tick (ISSUE 10): the
+                        # black box must show a storm the way it showed
+                        # a wedge.
+                        "cancelled": self._tick_cancelled,
+                        "deadline_expired": self._tick_deadline,
+                        "shed": self._tick_shed,
+                        "draining": draining,
                     }
                     if self._paged:
                         # Block occupancy + internal fragmentation (the
@@ -2171,15 +2601,11 @@ class SlotServer:
                     FLIGHT.record(rec)
                 self.slo.maybe_export(now)
 
-                if host_sync or stepped or ran_staged:
-                    tick += 1
-                elif pending:
-                    # Nothing running: fast-forward trace time to the next
-                    # arrival instead of spinning empty decode steps.
-                    tick = max(tick + 1,
-                               min(r.arrival_tick for r in pending))
-                else:
-                    break  # admit phase drained all without device work
+                # Every executed tick advances the clock by exactly one;
+                # idle iterations (fast-forward, live-feeder waits, the
+                # drained exit) were handled before the body, so span
+                # and flight-record counts track executed ticks.
+                tick += 1
         except BaseException as e:
             # The black-box contract: a wedged/crashed tick loop leaves
             # its last ticks on disk before the exception propagates.
@@ -2194,11 +2620,15 @@ class SlotServer:
             # Drained, not wedged: /healthz stays 200 "idle" between runs
             # however long this run's last tick ages.
             FLIGHT.mark_idle()
+        with self._ctl_lock:
+            # This run consumed its control state; the engine is reusable
+            # (a drain that completed must not auto-drain the next run).
+            # Entry only resets for STATIC traces, so a drain/cancel
+            # issued between spawning a live engine thread and the loop
+            # starting is honored, not wiped.
+            self._cancel_uids.clear()
+            self._draining = False
         wall = time.monotonic() - t0
-        for res in results:
-            res.queue_wait_s = wait_ledger.get(res.uid, 0.0)
-        # Prefill-sampled first tokens count toward the total.
-        tokens += sum(1 for _ in results)
         # Final SLO publication: the gauges reflect the run's end state and
         # the report carries the windowed snapshot (goodput + percentiles).
         self.slo.export_gauges()
@@ -2263,7 +2693,7 @@ class SlotServer:
             wall_s=wall,
             tokens_generated=tokens,
             mean_occupancy=occupancy / max(decode_ticks, 1),
-            tbt_s=tbt,
+            tbt_s=list(tbt),
             slo=slo_snap,
             prefix=prefix_snap,
             kv=kv_snap,

@@ -1,0 +1,661 @@
+"""Hardened streaming HTTP ingress for the serving engine (ISSUE 10).
+
+The serving spine (ragged tick → chunked prefill → paged KV → prefix
+cache → speculation) served pre-built synthetic traces; this module is
+the real front door, and the *robustness lifecycle* is the product:
+
+- ``POST /v1/completions`` — OpenAI-compatible shape (``prompt`` as a
+  token-id list, ``max_tokens``, ``stream``), answered as an SSE token
+  stream fed from the engine's one-per-tick fused token fetch (no
+  per-token host sync is added: the engine's callbacks hand tokens to a
+  per-request queue, the handler thread drains it).
+- **Client-disconnect cancellation** — a write to a vanished client (or
+  the keepalive probe between tokens) raises; the handler calls
+  :meth:`SlotServer.cancel`, and the next tick's control sweep retires
+  the request mid-flight: slot freed, prefix pins released, paged
+  blocks unmapped back to the pool. Cancellation is cheap by
+  construction — the paged allocator (arXiv:2309.06180) makes mid-
+  flight retirement a host-side unmap, zero KV bytes touched.
+- **Per-request deadlines** — ``deadline_s`` in the body (or the
+  server's default) becomes an absolute engine deadline: expired in
+  queue the request is rejected unserved, expired in flight it is
+  retired with outcome ``deadline`` — work that can no longer meet its
+  SLO is shed, not finished late.
+- **Backpressure** — a bounded admission queue: past ``max_queue``
+  waiting requests a submission gets ``429`` with ``Retry-After``
+  derived from the live queue depth and the SLO monitor's windowed
+  TTFT (``ceil(depth × max(ttft_p50, 50 ms) / slots)``, clamped to
+  [1, 60] s): the honest estimate of when a slot-share frees up.
+- **Graceful drain** — SIGTERM (via :func:`install_drain_signals`) or
+  :meth:`IngressServer.drain` stops admission (new submissions get
+  503), sheds the queued backlog, finishes in-flight requests, and
+  lets ``serve()`` return so the process exits through its normal
+  telemetry flush.
+
+Threading contract: handler threads never touch engine state directly —
+they go through exactly three thread-safe seams
+(:meth:`QueueRequestSource.submit`, :meth:`SlotServer.cancel`,
+:meth:`SlotServer.request_drain`), all mailboxes the tick loop sweeps at
+tick start, so every actual engine mutation stays on the engine thread.
+Ingress-local shared state is mutated only under ``self._lock`` — the
+invariant linter's lock-safety pass scopes this file.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from tree_attention_tpu import obs
+from tree_attention_tpu.serving.engine import (
+    OUTCOME_BUDGET,
+    OUTCOME_EOS,
+    Request,
+    RequestResult,
+    RequestSource,
+    ServeReport,
+    SlotServer,
+)
+from tree_attention_tpu.utils.httpd import DaemonHTTPServer
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("serving.ingress")
+
+# Ingress-plane metrics: HTTP outcomes by route/code (backpressure 429s
+# and drain 503s live here — they never became engine requests), SSE
+# disconnect detections, and the live admission-queue depth the
+# Retry-After formula reads.
+_HTTP_REQUESTS = obs.counter(
+    "serving_http_requests_total",
+    "ingress HTTP requests answered, by route and status code",
+    labels=("route", "code"),
+)
+_DISCONNECTS = obs.counter(
+    "serving_sse_disconnects_total",
+    "SSE streams whose client vanished mid-stream (each cancels its "
+    "request)",
+)
+_QUEUE_DEPTH = obs.gauge(
+    "serving_ingress_queue_depth",
+    "requests submitted to the ingress but not yet streaming tokens",
+)
+
+#: Engine outcome -> OpenAI-ish finish_reason. The happy arcs use the
+#: OpenAI vocabulary; the robustness arcs keep the engine's names — a
+#: client that asked for a deadline should see "deadline", not a lie.
+FINISH_REASONS = {OUTCOME_EOS: "stop", OUTCOME_BUDGET: "length"}
+
+_RETRY_AFTER_MIN_TTFT_S = 0.05
+_RETRY_AFTER_MAX_S = 60
+
+
+class QueueRequestSource(RequestSource):
+    """Thread-safe live feeder: HTTP handlers submit, the tick loop polls.
+
+    ``self._lock`` is a :class:`threading.Condition`: :meth:`submit`
+    notifies, :meth:`wait` blocks the idle engine until work (or close)
+    arrives — the loop never spins while the server sits idle.
+    """
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._queue: List[Request] = []
+        self._closed = False
+
+    def submit(self, req: Request) -> bool:
+        """Queue one request (any thread); False once closed (draining).
+        Stamps ``visible_at`` so the engine's queue-wait/TTFT clocks
+        start now, not at the loop's next poll."""
+        import time
+
+        with self._lock:
+            if self._closed:
+                return False
+            req.visible_at = time.monotonic()
+            self._queue.append(req)
+            self._lock.notify_all()
+            return True
+
+    def poll(self, tick: int) -> List[Request]:
+        with self._lock:
+            out = self._queue
+            self._queue = []
+        for r in out:
+            # Live requests have no synthetic arrival time; the tick the
+            # loop first saw them keeps results/report ordering sane.
+            r.arrival_tick = tick
+        return out
+
+    def wait(self, timeout: float) -> bool:
+        with self._lock:
+            if self._queue or self._closed:
+                return True
+            self._lock.wait(timeout)
+            return bool(self._queue)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    @property
+    def exhausted(self) -> bool:
+        with self._lock:
+            return self._closed and not self._queue
+
+
+class IngressServer(DaemonHTTPServer):
+    """The serving front door: one engine thread, N handler threads.
+
+    Args:
+      engine: the :class:`SlotServer` to serve from. :meth:`start` spawns
+        the engine's tick loop on a dedicated thread against a
+        :class:`QueueRequestSource`; :meth:`drain` (or SIGTERM via
+        :func:`install_drain_signals`) winds it down gracefully.
+      max_queue: bound on requests admitted-but-not-yet-streaming; past
+        it submissions get 429 + Retry-After (the backpressure seam).
+      default_deadline_s: deadline applied to requests that do not carry
+        their own ``deadline_s`` (None = no default — requests wait
+        forever).
+      default_max_tokens: ``max_tokens`` for bodies that omit it.
+      keepalive_s: seconds between SSE keepalive comments while no token
+        is ready — the probe that detects vanished clients even when the
+        engine is between tokens.
+    """
+
+    thread_name = "serving-ingress"
+
+    def __init__(
+        self,
+        engine: SlotServer,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        max_queue: int = 64,
+        default_deadline_s: Optional[float] = None,
+        default_max_tokens: int = 16,
+        keepalive_s: float = 0.5,
+    ):
+        super().__init__(port, host)
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.engine = engine
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self.default_max_tokens = default_max_tokens
+        self.keepalive_s = keepalive_s
+        self.source = QueueRequestSource()
+        # Reentrant: drain() runs inside the SIGTERM/SIGINT handler on
+        # the main thread, which may be interrupted while holding this
+        # lock (join()'s bookkeeping) — a plain Lock would self-deadlock
+        # the drain, the exact failure mode the obs crash-path rule
+        # exists for.
+        self._lock = threading.RLock()
+        self._next_uid = 0
+        self._queued = 0  # submitted, first token not yet streamed
+        self._draining = False
+        self._engine_thread: Optional[threading.Thread] = None
+        self._report: Optional[ServeReport] = None
+        self._engine_error: Optional[BaseException] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> int:
+        port = super().start()
+        with self._lock:
+            if self._engine_thread is None:
+                self._engine_thread = threading.Thread(
+                    target=self._run_engine,
+                    name="serving-engine",
+                    daemon=True,
+                )
+                self._engine_thread.start()
+        log.info("serving ingress: http://%s:%d/v1/completions",
+                 self._host, port)
+        return port
+
+    def _run_engine(self) -> None:
+        try:
+            report = self.engine.serve(self.source)
+        except BaseException as e:
+            log.exception("engine loop crashed; ingress is dead")
+            with self._lock:
+                self._engine_error = e
+            return
+        with self._lock:
+            self._report = report
+
+    def drain(self) -> None:
+        """Graceful shutdown, phase one (thread-safe, idempotent): stop
+        admitting (new POSTs get 503), shed the queued backlog, let
+        in-flight requests finish. The engine loop exits once drained;
+        :meth:`join` collects its report."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        log.info("ingress drain: admission stopped, finishing in-flight")
+        self.engine.request_drain()
+
+    def join(self, timeout: Optional[float] = None) -> Optional[ServeReport]:
+        """Wait for the engine loop to drain; returns its ServeReport
+        (None if still running at ``timeout``)."""
+        with self._lock:
+            t = self._engine_thread
+        if t is not None:
+            t.join(timeout)
+        with self._lock:
+            return self._report
+
+    def stop(self) -> None:
+        """Drain, collect the engine, then tear the HTTP server down."""
+        self.drain()
+        self.join(timeout=60.0)
+        super().stop()
+
+    @property
+    def report(self) -> Optional[ServeReport]:
+        with self._lock:
+            return self._report
+
+    @property
+    def engine_error(self) -> Optional[BaseException]:
+        """The exception that killed the engine loop, if any (callers
+        deciding an exit code must not mistake a crash for a drain)."""
+        with self._lock:
+            return self._engine_error
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queued
+
+    # -- routing ----------------------------------------------------------
+
+    def handle(self, method: str, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        if method == "POST" and path == "/v1/completions":
+            self._completions(req)
+        elif method == "GET" and path == "/ingress/stats":
+            self._reply_counted(req, "stats", 200,
+                                json.dumps(self._stats(), indent=2),
+                                "application/json")
+        elif method == "GET" and path == "/":
+            self._reply_counted(
+                req, "help", 200,
+                "tree_attention_tpu serving ingress: "
+                "POST /v1/completions  GET /ingress/stats\n",
+                "text/plain",
+            )
+        else:
+            self._reply_counted(req, "other", 404,
+                                f"no such endpoint: {method} {path}\n",
+                                "text/plain")
+
+    def _stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "queue_depth": self._queued,
+                "max_queue": self.max_queue,
+                "draining": self._draining,
+                "engine_done": self._report is not None,
+            }
+        out["slots"] = self.engine.slots
+        out["goodput"] = round(self.engine.slo.goodput(), 4)
+        return out
+
+    def _reply_counted(self, req, route: str, code: int, body: str,
+                       ctype: str, headers: Optional[dict] = None) -> None:
+        if obs.REGISTRY.enabled:
+            _HTTP_REQUESTS.labels(route=route, code=str(code)).inc()
+        self.reply(req, code, body, ctype, headers)
+
+    # -- the completions endpoint ------------------------------------------
+
+    def _completions(self, req: BaseHTTPRequestHandler) -> None:
+        import time
+
+        body, err = self._parse_body(req)
+        if err is not None:
+            self._reply_counted(req, "completions", 400,
+                                _error_json(err), "application/json")
+            return
+        # Admission control BEFORE any engine state is touched: drain
+        # beats backpressure beats service.
+        with self._lock:
+            if self._draining:
+                depth, verdict = self._queued, 503
+            elif self._queued >= self.max_queue:
+                depth, verdict = self._queued, 429
+            else:
+                self._queued += 1
+                depth, verdict = self._queued, 200
+                uid = self._next_uid
+                self._next_uid += 1
+        if verdict == 503:
+            self._reply_counted(
+                req, "completions", 503,
+                _error_json("server is draining; not accepting requests"),
+                "application/json",
+            )
+            return
+        if verdict == 429:
+            retry = self._retry_after(depth)
+            self._reply_counted(
+                req, "completions", 429,
+                _error_json(
+                    f"admission queue full ({depth} waiting); retry in "
+                    f"~{retry}s", type="overloaded"),
+                "application/json",
+                headers={"Retry-After": retry},
+            )
+            return
+        if obs.REGISTRY.enabled:
+            _QUEUE_DEPTH.set(depth)
+
+        events: "queue.Queue" = queue.Queue()
+        deadline = body.get("deadline_s", self.default_deadline_s)
+        request = Request(
+            uid=uid,
+            prompt=np.asarray(body["prompt"], np.int32),
+            max_new_tokens=body["max_tokens"],
+            eos_id=body.get("eos_id"),
+            deadline_s=(time.monotonic() + deadline
+                        if deadline is not None else None),
+            on_token=lambda t: events.put(("token", t)),
+            on_finish=lambda res: events.put(("finish", res)),
+        )
+        # Idempotent TTFT-phase exit: whichever comes first — first
+        # token, finish, or a disconnect — releases exactly one unit of
+        # admission-queue depth.
+        deq_state = [False]
+
+        def dequeue_once() -> None:
+            if not deq_state[0]:
+                deq_state[0] = True
+                self._dequeued()
+
+        if not self.source.submit(request):
+            dequeue_once()
+            self._reply_counted(
+                req, "completions", 503,
+                _error_json("server is draining; not accepting requests"),
+                "application/json",
+            )
+            return
+        try:
+            if body.get("stream", True):
+                self._stream_sse(req, uid, events, dequeue_once)
+            else:
+                self._respond_whole(req, uid, events, dequeue_once)
+        except BaseException as e:
+            # ANY handler failure — a vanished client (the disconnect
+            # arc the chaos harness storms: BrokenPipe/ConnectionReset/
+            # ConnectionAborted/timeouts), or an unexpected bug — must
+            # cancel the engine request and release its admission-queue
+            # unit, or max_queue such failures would brick the server
+            # with 429s while the engine sits idle.
+            if isinstance(e, OSError):
+                _DISCONNECTS.inc()
+            else:
+                log.exception("completions handler failed (rid %d)", uid)
+            self.engine.cancel(uid)
+            dequeue_once()
+            self._drain_events(events)
+            raise  # DaemonHTTPServer swallows the socket kinds
+
+    def _parse_body(self, req: BaseHTTPRequestHandler):
+        try:
+            n = int(req.headers.get("Content-Length", 0))
+            body = json.loads(req.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            return None, f"unreadable JSON body: {e}"
+        prompt = body.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in prompt)):
+            return None, (
+                "body.prompt must be a non-empty list of token ids (this "
+                "model serves token ids; there is no tokenizer in the "
+                "loop)"
+            )
+        if not all(-(1 << 31) <= t < (1 << 31) for t in prompt):
+            # Checked HERE so the int32 conversion after admission
+            # accounting can never raise (NumPy >= 2.0 overflows loudly).
+            return None, "body.prompt token ids must fit int32"
+        # Coerce every numeric field HERE, before any admission-queue
+        # accounting: a malformed field after the queue unit is taken
+        # would leak depth on its way out (the brick-the-server class).
+        try:
+            body["max_tokens"] = int(body.get("max_tokens",
+                                              self.default_max_tokens))
+            if body.get("deadline_s") is not None:
+                body["deadline_s"] = float(body["deadline_s"])
+            if body.get("eos_id") is not None:
+                body["eos_id"] = int(body["eos_id"])
+        except (TypeError, ValueError) as e:
+            return None, f"non-numeric max_tokens/deadline_s/eos_id: {e}"
+        return body, None
+
+    def _retry_after(self, depth: int) -> int:
+        """Seconds until a slot-share plausibly frees: queue depth times
+        the SLO window's observed TTFT p50 (floor 50 ms before any
+        sample exists), divided by the slots draining in parallel."""
+        ttft = max(self.engine.slo.snapshot().get("ttft_p50_s", 0.0),
+                   _RETRY_AFTER_MIN_TTFT_S)
+        est = math.ceil(depth * ttft / max(self.engine.slots, 1))
+        return max(1, min(est, _RETRY_AFTER_MAX_S))
+
+    def _dequeued(self) -> None:
+        """One request left the TTFT phase (first token, or finished
+        without one)."""
+        with self._lock:
+            self._queued -= 1
+            depth = self._queued
+        if obs.REGISTRY.enabled:
+            _QUEUE_DEPTH.set(depth)
+
+    @staticmethod
+    def _drain_events(events: "queue.Queue") -> None:
+        """After a disconnect: keep draining callback events until the
+        engine retires the request, so the queue (and the Request the
+        engine still holds) can be collected."""
+        while True:
+            try:
+                kind, _ = events.get(timeout=30.0)
+            except queue.Empty:
+                return  # engine gone/wedged; nothing more to free
+            if kind == "finish":
+                return
+
+    # -- response writers --------------------------------------------------
+
+    def _stream_sse(self, req: BaseHTTPRequestHandler, uid: int,
+                    events: "queue.Queue", dequeue_once) -> None:
+        """SSE token stream: one ``data:`` event per committed token, a
+        final event carrying ``finish_reason`` + usage, then ``[DONE]``.
+        Keepalive comments between tokens probe for vanished clients;
+        ~30 s of total engine silence (no event at all — tokens reset
+        the clock) means the engine thread is gone: cancel, emit an
+        error finish, return — a connected client must not hold an
+        admission-queue unit against a dead engine forever."""
+        if obs.REGISTRY.enabled:
+            _HTTP_REQUESTS.labels(route="completions", code="200").inc()
+        req.send_response(200)
+        req.send_header("Content-Type", "text/event-stream")
+        req.send_header("Cache-Control", "no-cache")
+        req.end_headers()
+        silent = 0
+        while True:
+            try:
+                kind, payload = events.get(timeout=self.keepalive_s)
+            except queue.Empty:
+                silent += 1
+                if silent * self.keepalive_s >= 30.0:
+                    self.engine.cancel(uid)
+                    dequeue_once()
+                    req.wfile.write(b"data: " + json.dumps(
+                        {"error": {
+                            "message": "engine unresponsive; "
+                                       "request cancelled",
+                            "type": "server_error",
+                        }}).encode() + b"\n\n")  # one line: SSE framing
+                    req.wfile.write(b"data: [DONE]\n\n")
+                    req.wfile.flush()
+                    return
+                # No token ready: probe the socket so a vanished client
+                # is detected even while its request sits in prefill.
+                req.wfile.write(b": keepalive\n\n")
+                req.wfile.flush()
+                continue
+            silent = 0
+            if kind == "token":
+                dequeue_once()
+                req.wfile.write(_sse_token(uid, payload))
+                req.wfile.flush()
+            else:
+                result: RequestResult = payload
+                dequeue_once()
+                req.wfile.write(_sse_finish(uid, result))
+                req.wfile.write(b"data: [DONE]\n\n")
+                req.wfile.flush()
+                return
+
+    def _respond_whole(self, req: BaseHTTPRequestHandler, uid: int,
+                       events: "queue.Queue", dequeue_once) -> None:
+        """``stream: false``: block until the request finishes, answer
+        with one JSON body. The wait is bounded per EVENT (tokens reset
+        it): 30 s of total silence means the engine thread is gone —
+        cancel and answer rather than hang the handler (and its
+        admission-queue unit) forever; the SSE path gets the same bound
+        from its keepalive probe + _drain_events."""
+        while True:
+            try:
+                kind, payload = events.get(timeout=30.0)
+            except queue.Empty:
+                self.engine.cancel(uid)
+                dequeue_once()
+                self._reply_counted(
+                    req, "completions", 503,
+                    _error_json("engine unresponsive; request cancelled",
+                                type="server_error"),
+                    "application/json",
+                )
+                return
+            if kind == "token":
+                # Same TTFT-phase semantics as the SSE path: a
+                # generating request occupies a slot, not the
+                # admission queue.
+                dequeue_once()
+                continue
+            result: RequestResult = payload
+            break
+        dequeue_once()
+        reason = FINISH_REASONS.get(result.outcome, result.outcome)
+        code = 200 if result.tokens or reason in ("stop", "length") else 503
+        self._reply_counted(req, "completions", code, json.dumps({
+            "id": f"cmpl-{uid}",
+            "object": "text_completion",
+            "choices": [{
+                "index": 0,
+                "text": _render(result.tokens),
+                "token_ids": list(result.tokens),
+                "finish_reason": reason,
+            }],
+            "usage": {
+                "prompt_tokens": result.prompt_len,
+                "completion_tokens": len(result.tokens),
+            },
+        }, indent=2), "application/json")
+
+
+# -- SSE wire helpers -------------------------------------------------------
+
+
+def _render(tokens) -> str:
+    """Token ids as text — space-separated ids (no tokenizer exists in
+    this stack; honest rendering beats pretending)."""
+    return " ".join(str(int(t)) for t in tokens)
+
+
+def _sse_token(uid: int, tok: int) -> bytes:
+    return ("data: " + json.dumps({
+        "id": f"cmpl-{uid}",
+        "object": "text_completion",
+        "choices": [{
+            "index": 0,
+            "text": f"{int(tok)} ",
+            "token_ids": [int(tok)],
+            "finish_reason": None,
+        }],
+    }) + "\n\n").encode()
+
+
+def _sse_finish(uid: int, result: RequestResult) -> bytes:
+    return ("data: " + json.dumps({
+        "id": f"cmpl-{uid}",
+        "object": "text_completion",
+        "choices": [{
+            "index": 0,
+            "text": "",
+            "token_ids": [],
+            "finish_reason": FINISH_REASONS.get(result.outcome,
+                                                result.outcome),
+        }],
+        "usage": {
+            "prompt_tokens": result.prompt_len,
+            "completion_tokens": len(result.tokens),
+        },
+    }) + "\n\n").encode()
+
+
+def _error_json(message: str, type: str = "invalid_request") -> str:
+    return json.dumps({"error": {"message": message, "type": type}},
+                      indent=2)
+
+
+def install_drain_signals(server: IngressServer) -> None:
+    """SIGTERM/SIGINT → graceful drain (main thread only).
+
+    Replaces the obs crash handler's flush-then-die SIGTERM for the
+    serving process: the drain lets in-flight requests finish, the
+    engine loop returns, and the process exits through its normal
+    telemetry flush (the CLI's ``finally``/atexit path) — stop
+    admitting, finish in-flight, flush telemetry, in that order. A
+    second signal while draining falls back to the previous handler
+    (an operator's double-SIGTERM must still kill a stuck drain).
+    """
+    import signal
+
+    prev = {}
+
+    def _begin_drain(signum, frame):
+        if server.draining:
+            # Second signal while draining: escalate — an operator's
+            # kill must stay a kill even if the drain is stuck. A
+            # callable previous handler runs; otherwise restore the
+            # default disposition and re-raise the signal.
+            handler = prev.get(signum)
+            if callable(handler):
+                handler(signum, frame)
+            else:
+                signal.signal(signum, signal.SIG_DFL)
+                import os
+
+                os.kill(os.getpid(), signum)
+            return
+        server.drain()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, _begin_drain)

@@ -174,6 +174,20 @@ class _RadixBase:
             n.refs -= 1
             assert n.refs >= 0, "prefix node ref underflow"
 
+    def total_pins(self) -> int:
+        """Sum of every node's refcount — the pin-balance truth. A
+        drained engine (every request retired, however it exited) must
+        read 0 here: admit-time pins are released at retire on EVERY
+        outcome arc, cancellation and deadline expiry included (the
+        chaos-harness contract, ISSUE 10)."""
+        total = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            total += n.refs
+        return total
+
     def _lru_leaf(self) -> Optional[_Node]:
         """The least-recently-used refcount-0 leaf, or None when every
         block is pinned (directly or through a pinned descendant)."""

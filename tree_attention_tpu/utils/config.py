@@ -104,6 +104,11 @@ class RunConfig:
     speculate: bool = False  # draft-and-verify speculative decoding
     draft_k: int = 4         # max draft tokens per slot per verify tick
     drafter: str = "ngram"   # ngram | ngram-tree | model
+    # HTTP ingress (ISSUE 10): --serve-http turns serve mode into a live
+    # streaming front-end instead of a synthetic-trace run.
+    serve_http: Optional[int] = None  # port (0 = OS-picked, logged)
+    max_queue: int = 64      # ingress admission-queue bound (429 past it)
+    default_deadline: Optional[float] = None  # seconds; None = no default
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -334,6 +339,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "mask (SpecInfer, arXiv:2305.09781); 'model' = "
                         "a shrunk draft transformer (half depth, same "
                         "vocab, --seed+3)")
+    p.add_argument("--serve-http", type=int, default=d.serve_http,
+                   metavar="PORT",
+                   help="serve mode: run the streaming HTTP ingress on "
+                        "localhost:PORT (0 picks a free port, logged) "
+                        "instead of draining a synthetic trace — "
+                        "OpenAI-compatible POST /v1/completions with SSE "
+                        "token streaming, client-disconnect cancellation, "
+                        "per-request deadlines, 429+Retry-After "
+                        "backpressure; SIGTERM drains gracefully "
+                        "(finish in-flight, flush telemetry)")
+    p.add_argument("--max-queue", type=int, default=d.max_queue,
+                   help="--serve-http: max requests queued ahead of "
+                        "first token; submissions past it get 429 with "
+                        "Retry-After derived from queue depth and the "
+                        "SLO monitor's windowed TTFT")
+    p.add_argument("--default-deadline", type=float,
+                   default=d.default_deadline, metavar="SEC",
+                   help="--serve-http: deadline for requests that do "
+                        "not carry their own deadline_s — expired in "
+                        "queue they are rejected, expired in flight "
+                        "retired with outcome 'deadline'")
     p.add_argument("--prefix-share", type=float, default=d.prefix_share,
                    help="serve mode: fraction of the synthetic trace's "
                         "requests drawing their prompt head from a shared "
