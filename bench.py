@@ -676,6 +676,21 @@ def _serving_ingress_record():
     return bench_serving_ingress()
 
 
+def _serving_fleet_record():
+    """Prefix-affinity fleet (ISSUE 11): four replica engines behind the
+    cache-aware router on a multi-tenant shared-prefix heavy-tail trace
+    (SGLang's cache-aware routing, arXiv:2312.07104) — affinity vs
+    round-robin at equal total slots/pool bytes (TTFT p50 + tokens-
+    reused ratio must both be strictly better with affinity), routed
+    streams parity-gated against direct serving, and a full rolling
+    restart DURING a replay with zero dropped accepted requests and
+    leak-free drained allocators. CPU proxy; the routing structure is
+    the claim. See tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_fleet
+
+    return bench_serving_fleet()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -911,6 +926,7 @@ def _run_suite() -> None:
     run("serving_paged_flood", _serving_paged_record)
     run("serving_speculative", _serving_spec_record)
     run("serving_ingress_chaos", _serving_ingress_record)
+    run("serving_fleet", _serving_fleet_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1042,6 +1058,15 @@ def _summarize_record(name, rec):
         acc = trace.get("on", {}).get("acceptance_rate")
         if acc is not None:
             out["acceptance_rate"] = acc
+    if name == "serving_fleet":
+        gain = rec.get("fleet_affinity_gain", {})
+        for key in ("ttft_improvement", "reused_ratio_improvement",
+                    "affinity_share"):
+            if gain.get(key) is not None:
+                out[key] = gain[key]
+        roll = rec.get("rolling_restart", {})
+        if "dropped_total" in roll:
+            out["restart_dropped"] = roll["dropped_total"]
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

@@ -57,6 +57,24 @@ _SPEC.loader.exec_module(bc)
     ("draft_k", None),
     ("verify_bucket", None),
     ("verify_ticks", None),
+    # Fleet record (ISSUE 11): affinity gains are larger-is-better
+    # ratios, dropped counts guard exactly (pinned 0), and fleet shape
+    # / routing-interleaving counts are workload echoes that skip.
+    ("affinity_share", bc.LARGER_IS_BETTER),
+    ("reused_ratio_improvement", bc.LARGER_IS_BETTER),
+    ("ttft_improvement", bc.LARGER_IS_BETTER),
+    ("dropped_total", bc.EXACT),
+    ("serving_router_requests_total", bc.EXACT),
+    ("replicas", None),
+    ("slots_per_replica", None),
+    ("kv_blocks_per_replica", None),
+    ("tenants", None),
+    ("tenant_prefix_len", None),
+    ("deadline_calib_s", None),
+    ("routed_affinity", None),
+    ("routed_least_loaded", None),
+    ("routed_failover", None),
+    ("requeued", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family

@@ -576,6 +576,40 @@ class TestLockSafety:
         # reach it only through the mailbox seams.
         assert run("lock-safety", snippet, path=ENGINE) == []
 
+    def test_router_and_fleet_in_scope(self):
+        # ISSUE 11: the fleet tier's handler/monitor threads share the
+        # replica registry, approximate trees, and restart budgets —
+        # serving/router.py and serving/fleet.py join the lock-safety
+        # scope with the same mutate-under-self._lock contract.
+        snippet = (
+            "import threading\n"
+            "class Router:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._inflight = {}\n"
+            "    def choose(self, name):\n"
+            "        self._inflight[name] = 1\n"
+        )
+        for path in ("tree_attention_tpu/serving/router.py",
+                     "tree_attention_tpu/serving/fleet.py"):
+            fs = run("lock-safety", snippet, path=path)
+            assert len(fs) == 1 and "self._inflight" in fs[0].message, path
+        # ...and the engine module still is NOT in scope.
+        assert run("lock-safety", snippet, path=ENGINE) == []
+
+    def test_router_locked_mutation_clean(self):
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class Router:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._trees = {}\n"
+            "    def rejoin(self, name):\n"
+            "        with self._lock:\n"
+            "            self._trees.pop(name, None)\n"
+        ), path="tree_attention_tpu/serving/router.py")
+        assert fs == []
+
     def test_ingress_locked_mutation_and_condition_lock_clean(self):
         # The live feeder's Condition doubles as its lock; mutations
         # under `with self._lock:` pass, and Condition() on a class with

@@ -517,7 +517,8 @@ def test_http_sse_stream_and_whole_agree(params, live):
     assert body["choices"][0]["token_ids"] == toks
     assert body["choices"][0]["finish_reason"] == "length"
     assert body["usage"] == {"prompt_tokens": len(prompt),
-                             "completion_tokens": 6}
+                             "completion_tokens": 6,
+                             "prefix_hit_tokens": 0}
     assert toks == ref_tokens(params, SHORT_PROMPT, 6)
 
 

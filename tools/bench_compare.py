@@ -61,6 +61,9 @@ _LARGER_SUBSTRINGS = (
     # accepted counts and committed-tokens-per-verify are ratio-like
     # quality metrics — 20% rtol, larger is better.
     "accept", "tokens_per_verify",
+    # Fleet routing family (ISSUE 11): the share of routed requests the
+    # affinity rule placed — a routing-quality ratio, larger is better.
+    "affinity_share",
 )
 # Ratio-shaped keys where SMALLER is better (checked before the
 # larger-is-better substrings — "cost" beats "ratio").
@@ -89,6 +92,13 @@ _IGNORE_KEYS = frozenset((
     "rejected_429", "shed_or_expired", "met", "served", "burst",
     "interactive_deadline_s", "batch_deadline_s",
     "makespan_calib_s", "cancelled", "deadline_expired", "shed",
+    # Fleet record (ISSUE 11): fleet shape and routing/restart
+    # interleaving counts are workload echoes, not performance — the
+    # guarded metrics are the ttft/reused_ratio/improvement keys,
+    # affinity_share, and the exact dropped_total counts (pinned 0).
+    "replicas", "slots_per_replica", "kv_blocks_per_replica", "tenants",
+    "tenant_prefix_len", "deadline_calib_s", "routed_affinity",
+    "routed_least_loaded", "routed_failover", "requeued",
 ))
 
 

@@ -35,8 +35,12 @@ import sys
 # linter; 436 measured pre-review + 6 review-fix regression tests in
 # tests/test_lint.py = 442), 462 after PR 9 (HTTP ingress: cancellation/
 # deadline/drain edges + live loopback SSE tests + lock-safety ingress
-# scope fixtures; 463 measured). Raise as PRs add tests.
-FLOOR = 462
+# scope fixtures; 463 measured), 512 after PR 10 (prefix-affinity fleet:
+# router scoring/tree/federation units + loopback fleet integration +
+# router/fleet hardening regression tests + lock-safety router/fleet
+# scope fixtures + bench_compare fleet families; 513 measured). Raise
+# as PRs add tests.
+FLOOR = 512
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

@@ -29,10 +29,14 @@ Scope: ``tree_attention_tpu/obs/`` and — since ISSUE 10 —
 ``tree_attention_tpu/serving/ingress.py``: its HTTP handler threads
 share state with the engine thread (queue depth, drain flag, the live
 feeder's queue), and the same mutate-under-``self._lock`` contract
-applies to every ingress class owning one. The engine itself stays out
-of scope by design: handler threads reach it only through the three
-mailbox seams (``submit``/``cancel``/``request_drain``), so all other
-``SlotServer`` state remains single-threaded.
+applies to every ingress class owning one. Since ISSUE 11 the fleet
+tier joins too: ``serving/router.py`` (handler threads share the
+replica registry, approximate trees, and in-flight counters) and
+``serving/fleet.py`` (the supervisor's monitor thread shares replica
+handles and restart budgets with the caller thread). The engine itself
+stays out of scope by design: handler threads reach it only through the
+three mailbox seams (``submit``/``cancel``/``request_drain``), so all
+other ``SlotServer`` state remains single-threaded.
 """
 
 from __future__ import annotations
@@ -59,7 +63,11 @@ _SIGNAL_ROOTS = _CRASH_METHODS | {"_on_term", "_on_usr1"}
 
 def _in_scope(path: str) -> bool:
     return (path.startswith("tree_attention_tpu/obs/")
-            or path == "tree_attention_tpu/serving/ingress.py")
+            or path in (
+                "tree_attention_tpu/serving/ingress.py",
+                "tree_attention_tpu/serving/router.py",
+                "tree_attention_tpu/serving/fleet.py",
+            ))
 
 
 def _under_lock(node: ast.AST) -> bool:

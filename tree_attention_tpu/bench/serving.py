@@ -1175,6 +1175,10 @@ def heavy_tail_trace(
     tail_scale: float = 8.0,
     vocab_size: int = 128,
     seed: int = 0,
+    tenants: int = 0,
+    tenant_prefix_len: int = 0,
+    tenant_zipf: float = 1.2,
+    prefix_seed: Optional[int] = None,
 ) -> List[Dict[str, Any]]:
     """A production-shaped replay trace: timestamped request events with
     exponential inter-arrivals and heavy-tail (Pareto) prompt/output
@@ -1183,22 +1187,65 @@ def heavy_tail_trace(
     identical requests flatters every scheduler). Lengths are clamped so
     ``prompt + max_tokens`` always fits a ``cache_len`` slot. Events are
     plain dicts (``t_s``, ``prompt``, ``max_tokens``) so they serialize
-    to the JSONL trace files ``save_trace``/``load_trace`` round-trip."""
+    to the JSONL trace files ``save_trace``/``load_trace`` round-trip.
+
+    **Multi-tenant shared-prefix mixture (ISSUE 11):** with
+    ``tenants > 0`` and ``tenant_prefix_len > 0``, each request draws a
+    tenant from a bounded Zipf distribution (rank-k probability
+    proportional to ``(k+1)^-tenant_zipf`` — a few tenants dominate, a
+    long tail trickles, the skew production multi-tenancy shows) and
+    prepends that tenant's fixed prefix (its "system prompt") to its
+    heavy-tail random suffix. This is the workload affinity routing
+    exists for: the same tenant's requests share a long prefix, and a
+    router that scatters them round-robin pays the prefill N times.
+    ``prefix_seed`` draws the tenant prefix *populations* from their own
+    rng stream, so two arms with the same ``seed`` (identical arrivals,
+    lengths, suffix randomness) can still use disjoint prefix
+    populations — per-arm cold caches without rebuilding engines.
+    Events carry ``tenant`` for analysis.
+    """
     rng = np.random.default_rng(seed)
-    cap = cache_len - prompt_base - new_base
+    shared: List[np.ndarray] = []
+    zipf_p = None
+    if tenants > 0 and tenant_prefix_len > 0:
+        prefix_rng = rng if prefix_seed is None else \
+            np.random.default_rng(prefix_seed)
+        shared = [
+            prefix_rng.integers(0, vocab_size, size=tenant_prefix_len)
+            .astype(np.int32)
+            for _ in range(tenants)
+        ]
+        zipf_p = np.array([(k + 1.0) ** -tenant_zipf
+                           for k in range(tenants)])
+        zipf_p /= zipf_p.sum()
+    head = tenant_prefix_len if shared else 0
+    cap = cache_len - head - prompt_base - new_base
+    if cap < 0:
+        raise ValueError(
+            f"cache_len {cache_len} cannot fit tenant_prefix_len {head} "
+            f"plus prompt_base {prompt_base} + new_base {new_base}"
+        )
     events = []
     t = 0.0
     for i in range(n_requests):
         t += float(rng.exponential(mean_gap_s))
-        plen = prompt_base + int(min(rng.pareto(1.5) * tail_scale, cap // 2))
+        plen = prompt_base + int(min(rng.pareto(1.5) * tail_scale,
+                                     max(cap // 2, 0)))
         new = new_base + int(min(rng.pareto(1.5) * tail_scale,
-                                 cache_len - plen - new_base))
-        events.append({
+                                 cache_len - head - plen - new_base))
+        suffix = rng.integers(0, vocab_size, size=plen).astype(np.int32)
+        ev = {
             "t_s": round(t, 6),
-            "prompt": rng.integers(0, vocab_size, size=plen).astype(
-                np.int32).tolist(),
             "max_tokens": int(new),
-        })
+        }
+        if shared:
+            tenant = int(rng.choice(tenants, p=zipf_p))
+            ev["tenant"] = tenant
+            ev["prompt"] = np.concatenate(
+                [shared[tenant], suffix]).tolist()
+        else:
+            ev["prompt"] = suffix.tolist()
+        events.append(ev)
     return events
 
 
@@ -1618,6 +1665,257 @@ def bench_serving_ingress(
         dict(d=rec["disconnect_storm"]["disconnected"],
              off=off["goodput_under_slo"], on=on["goodput_under_slo"],
              r=len(n429), b=len(burst)),
+    )
+    return rec
+
+
+def bench_serving_fleet(
+    *,
+    replicas: int = 4,
+    slots: int = 2,
+    cache_len: int = 96,
+    n_requests: int = 40,
+    n_parity: int = 6,
+    tenants: int = 6,
+    tenant_prefix_len: int = 48,
+    tenant_zipf: float = 1.2,
+    mean_gap_s: float = 0.01,
+    cfg: Optional[TransformerConfig] = None,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """The fleet record (ISSUE 11): N replica engines behind the
+    cache-aware router, affinity vs round-robin at EQUAL total
+    slots/pool bytes (both arms run the SAME fleet — only the routing
+    policy flips).
+
+    Four claims, measured live over loopback:
+
+    - **parity** — streams routed through the router are token-for-token
+      identical to direct single-replica serving (the pass-through
+      guarantee).
+    - **affinity preserves the prefix win** — on a multi-tenant
+      shared-prefix heavy-tail trace (Zipf tenant skew), affinity
+      routing shows strictly better TTFT p50 AND strictly higher
+      prefix tokens-reused ratio than round-robin over the same
+      replicas: round-robin scatters each tenant's prefix across N
+      trees and pays the prefill ~N times; affinity concentrates it.
+      Each arm draws its own tenant prefix *population*
+      (``prefix_seed``), so both start with cold caches for their own
+      prefixes without rebuilding engines.
+    - **rolling restart without drops** — a full rolling restart runs
+      DURING a replay; every accepted request still finishes (drained
+      replicas' queued work requeues onto peers), and each drained
+      replica's allocator reads 0 private blocks / 0 reservations /
+      0 pins at the drain point.
+
+    Deadlines are calibrated from the parity arm's measured completion
+    times (the chaos-bench lesson: absolute seconds do not transfer
+    across boxes) at 10x p95 — loose enough never to bind, present so
+    the fleet path carries real deadline budgets through failover.
+    """
+    import threading as _threading
+
+    from tree_attention_tpu.serving import Request as _Request
+    from tree_attention_tpu.serving.fleet import (
+        FleetSupervisor, LocalReplica,
+    )
+    from tree_attention_tpu.serving.router import FleetRouter
+
+    block = 16
+    cfg = cfg or serving_model_config(
+        max_seq_len=cache_len, vocab_size=128, d_model=64
+    )
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    kv_blocks = slots * (-(-cache_len // block)) + 24  # slot worst case
+    # plus prefix retention — the per-replica pool every arm shares
+
+    def make_engine():
+        return SlotServer(
+            params, cfg, slots=slots, cache_len=cache_len,
+            prefill_chunk=block, prefix_cache=True, prefix_block=block,
+            kv_blocks=kv_blocks,
+        )
+
+    reps = [LocalReplica(f"r{i}", make_engine, max_queue=n_requests + 8,
+                         default_max_tokens=8, keepalive_s=0.1)
+            for i in range(replicas)]
+    router = FleetRouter(block=block, affinity=True, hysteresis=2)
+    sup = FleetSupervisor(reps, router=router, monitor_interval_s=0)
+
+    def mt_trace(n, prefix_seed, gap=mean_gap_s):
+        return heavy_tail_trace(
+            n, cache_len=cache_len, mean_gap_s=gap,
+            vocab_size=cfg.vocab_size, seed=seed + 2,
+            tenants=tenants, tenant_prefix_len=tenant_prefix_len,
+            tenant_zipf=tenant_zipf, prefix_seed=prefix_seed,
+        )
+
+    # --- parity: direct reference BEFORE the fleet starts (replica 0's
+    # engine, same instance the fleet then reuses — no extra compiles).
+    parity_trace = mt_trace(n_parity, seed + 101, gap=0.0)
+    ref_engine = reps[0].engine
+    with obs.span("bench_serving_fleet:reference", cat="bench"):
+        ref_report = ref_engine.serve([
+            _Request(uid=i, prompt=np.asarray(e["prompt"], np.int32),
+                     max_new_tokens=e["max_tokens"])
+            for i, e in enumerate(parity_trace)
+        ])
+    ref_streams = {r.uid: list(r.tokens) for r in ref_report.results}
+    completions = sorted(r.completion_s for r in ref_report.results)
+    deadline = max(10.0 * completions[-1], 2.0)
+
+    port = sup.start()
+    rec: Dict[str, Any] = {"workload": {
+        "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                  "vocab": cfg.vocab_size},
+        "replicas": replicas, "slots_per_replica": slots,
+        "cache_len": cache_len, "kv_blocks_per_replica": kv_blocks,
+        "n_requests": n_requests, "tenants": tenants,
+        "tenant_prefix_len": tenant_prefix_len,
+        "deadline_calib_s": round(deadline, 3),
+    }}
+
+    engines = sup.engines
+
+    def settle_all():
+        for eng in engines:
+            _wait_engine_settled(eng)
+
+    with obs.span("bench_serving_fleet:parity", cat="bench"):
+        routed = replay_trace_http(port, parity_trace)
+        settle_all()
+    mismatched = [i for i, r in enumerate(routed)
+                  if r["tokens"] != ref_streams[i]]
+    rec["parity"] = {"requests": n_parity,
+                     "identical": not mismatched,
+                     "mismatched": mismatched}
+    assert not mismatched, (
+        f"FLEET PARITY VIOLATION: routed streams differ from direct "
+        f"serving at indices {mismatched}"
+    )
+
+    # --- affinity vs round-robin, equal fleet, per-arm prefix population.
+    def run_arm(affinity: bool, prefix_seed: int) -> Dict[str, Any]:
+        trace = mt_trace(n_requests, prefix_seed)
+        for e in trace:
+            e["deadline_s"] = deadline
+        router.affinity = affinity
+        before = [eng.prefix_stats().get("tokens_reused", 0)
+                  for eng in engines]
+        routed0 = dict(router.stats()["routed"])
+        res = replay_trace_http(port, trace)
+        settle_all()
+        reused = sum(
+            eng.prefix_stats().get("tokens_reused", 0) - b
+            for eng, b in zip(engines, before)
+        )
+        routed1 = router.stats()["routed"]
+        prompt_tokens = sum(len(e["prompt"]) for e in trace)
+        ttfts = sorted(r["ttft_s"] for r in res
+                       if r["ttft_s"] is not None)
+        served = sum(1 for r in res
+                     if r["finish_reason"] in ("stop", "length"))
+        assert served == n_requests, (
+            f"arm affinity={affinity}: only {served}/{n_requests} served"
+        )
+        return {
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+            "ttft_p95_s": round(
+                ttfts[min(int(len(ttfts) * 0.95), len(ttfts) - 1)], 4),
+            "reused_ratio": round(reused / prompt_tokens, 4),
+            "tokens_total": sum(len(r["tokens"]) for r in res),
+            "served": served,
+            **{f"routed_{k}": routed1[k] - routed0.get(k, 0)
+               for k in routed1},
+        }
+
+    with obs.span("bench_serving_fleet:round_robin", cat="bench"):
+        rr = run_arm(affinity=False, prefix_seed=seed + 202)
+    with obs.span("bench_serving_fleet:affinity", cat="bench"):
+        aff = run_arm(affinity=True, prefix_seed=seed + 303)
+    rec["round_robin"] = rr
+    rec["affinity"] = aff
+    routed_total = sum(v for k, v in aff.items()
+                       if k.startswith("routed_"))
+    rec["fleet_affinity_gain"] = {
+        "ttft_improvement": round(rr["ttft_p50_s"] / aff["ttft_p50_s"], 3)
+        if aff["ttft_p50_s"] else None,
+        "reused_ratio_improvement": round(
+            aff["reused_ratio"] / rr["reused_ratio"], 3
+        ) if rr["reused_ratio"] else None,
+        "affinity_share": round(
+            aff["routed_affinity"] / routed_total, 4
+        ) if routed_total else 0.0,
+    }
+    # The acceptance criteria, asserted live like every serving record's
+    # claims: affinity must PRESERVE the prefix win, not dilute it.
+    assert aff["ttft_p50_s"] < rr["ttft_p50_s"], (
+        f"AFFINITY REGRESSION: ttft p50 affinity={aff['ttft_p50_s']} >= "
+        f"round_robin={rr['ttft_p50_s']}"
+    )
+    assert aff["reused_ratio"] > rr["reused_ratio"], (
+        f"AFFINITY REGRESSION: reused_ratio affinity="
+        f"{aff['reused_ratio']} <= round_robin={rr['reused_ratio']}"
+    )
+
+    # --- rolling restart DURING a replay: zero dropped accepted work.
+    roll_trace = mt_trace(n_requests, seed + 404)
+    for e in roll_trace:
+        e["deadline_s"] = deadline
+    roll_out: Dict[str, Any] = {}
+
+    def do_roll():
+        import time as _time
+
+        _time.sleep(0.2)  # let the replay get some work in flight
+        roll_out.update(sup.rolling_restart())
+
+    roller = _threading.Thread(target=do_roll, daemon=True)
+    with obs.span("bench_serving_fleet:rolling_restart", cat="bench"):
+        roller.start()
+        res = replay_trace_http(port, roll_trace)
+        roller.join(timeout=120.0)
+        settle_all()
+    accepted = [r for r in res if r["status"] == 200]
+    dropped = [r["i"] for r in accepted
+               if r["finish_reason"] not in ("stop", "length")]
+    leaks_clean = all(
+        lk.get("leak") is not None  # a drain-timeout skip is NOT clean
+        and lk["leak"]["blocks_private"] == 0
+        and lk["leak"]["blocks_reserved"] == 0
+        and lk["leak"]["pins"] == 0
+        for lk in roll_out.values()
+    ) if roll_out else False
+    stats = router.stats()
+    rec["rolling_restart"] = {
+        "accepted": len(accepted),
+        "dropped_total": len(dropped),
+        "dropped": dropped,
+        "requeued": stats["requeued"],
+        "router_dropped_total": stats["dropped"],
+        "replicas_rolled": len(roll_out),
+        "drained_leak_free": leaks_clean,
+    }
+    assert len(accepted) == n_requests, (
+        f"ROLLING RESTART: only {len(accepted)}/{n_requests} accepted "
+        f"(statuses {[r['status'] for r in res]})"
+    )
+    assert not dropped, (
+        f"ROLLING RESTART DROPPED accepted request(s) {dropped}"
+    )
+    assert len(roll_out) == replicas and leaks_clean, (
+        f"ROLLING RESTART: drained replicas not leak-free: {roll_out}"
+    )
+
+    sup.stop()
+    log.info(
+        "fleet bench: parity OK; affinity ttft p50 %.4fs vs rr %.4fs "
+        "(%.2fx), reused %.3f vs %.3f; rolling restart served %d/%d "
+        "with %d requeue(s)",
+        aff["ttft_p50_s"], rr["ttft_p50_s"],
+        rec["fleet_affinity_gain"]["ttft_improvement"] or 0.0,
+        aff["reused_ratio"], rr["reused_ratio"],
+        len(accepted) - len(dropped), n_requests, stats["requeued"],
     )
     return rec
 

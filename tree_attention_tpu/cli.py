@@ -482,6 +482,13 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         )
     if cfg.max_queue < 1:
         raise SystemExit("--max-queue must be >= 1")
+    if cfg.serve_fleet and cfg.serve_http is not None:
+        raise SystemExit(
+            "--serve-fleet and --serve-http are exclusive: the router "
+            "IS the fleet's HTTP front door (it listens on --router-port)"
+        )
+    if cfg.serve_fleet and cfg.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
     if cfg.default_deadline is not None and cfg.default_deadline <= 0:
         raise SystemExit("--default-deadline must be > 0 seconds")
     if cfg.speculate and cfg.temperature != 0.0:
@@ -588,8 +595,7 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             init_params(jax.random.PRNGKey(cfg.seed + 3), draft_cfg),
             draft_cfg,
         )
-    server = SlotServer(
-        params, tcfg,
+    engine_kw = dict(
         slots=cfg.slots, cache_len=cache_len, mesh=mesh,
         quantize=cfg.kv_quant != "none",
         quant_kernel=cfg.resolved_quant_kernel() or "q8q",
@@ -609,7 +615,73 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         draft_k=cfg.draft_k,
         drafter=drafter,
     )
+
+    def make_engine() -> SlotServer:
+        return SlotServer(params, tcfg, **engine_kw)
+
     from tree_attention_tpu.host_runtime import heartbeat
+
+    if cfg.serve_fleet:
+        # The fleet tier (ISSUE 11): --replicas in-process engines, each
+        # behind its own loopback ingress, fronted by the cache-aware
+        # router — one process, N engines (the CPU-proxy honest shape;
+        # ProcessReplica + FleetSupervisor serve the multi-host story).
+        from tree_attention_tpu.serving.fleet import (
+            FleetSupervisor,
+            LocalReplica,
+            install_fleet_drain_signals,
+        )
+        from tree_attention_tpu.serving.router import FleetRouter
+
+        if not cfg.prefix_cache:
+            log.warning(
+                "--serve-fleet without --prefix-cache: affinity routing "
+                "groups shared prefixes per replica, but no replica can "
+                "reuse them — expect no TTFT win"
+            )
+        reps = [
+            LocalReplica(
+                f"r{i}", make_engine,
+                max_queue=cfg.max_queue,
+                default_deadline_s=cfg.default_deadline,
+                default_max_tokens=cfg.max_new_tokens,
+            )
+            for i in range(cfg.replicas)
+        ]
+        router = FleetRouter(
+            port=cfg.router_port,
+            block=cfg.prefix_block,
+            affinity=cfg.affinity == "on",
+        )
+        fleet = FleetSupervisor(reps, router=router)
+        drained = install_fleet_drain_signals(fleet)
+        port = fleet.start()
+        log.info(
+            "serving fleet on http://127.0.0.1:%d/v1/completions "
+            "(%d replica(s) x %d slot(s), cache_len %d, affinity %s) — "
+            "SIGTERM rolls the fleet down gracefully",
+            port, cfg.replicas, cfg.slots, cache_len, cfg.affinity,
+        )
+        heartbeat()
+        drained.wait()  # blocks until SIGTERM/SIGINT
+        fleet.stop()
+        heartbeat()
+        _emit({
+            "mode": "serve",
+            "fleet": {
+                "router_port": port,
+                "replicas": cfg.replicas,
+                "affinity": cfg.affinity,
+                "router": router.stats(),
+                "leaks": fleet.leak_reports(),
+            },
+            "slots": cfg.slots,
+            "cache_len": cache_len,
+            "kv_layout": cfg.kv_layout,
+        })
+        return 0
+
+    server = make_engine()
 
     if cfg.serve_http is not None:
         # The live ingress (ISSUE 10): serve real HTTP traffic until a

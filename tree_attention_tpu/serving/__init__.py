@@ -20,6 +20,16 @@ from tree_attention_tpu.serving.engine import (  # noqa: F401
     synthetic_trace,
 )
 from tree_attention_tpu.serving.block_pool import BlockAllocator  # noqa: F401
+from tree_attention_tpu.serving.fleet import (  # noqa: F401
+    FleetSupervisor,
+    LocalReplica,
+    ProcessReplica,
+)
+from tree_attention_tpu.serving.router import (  # noqa: F401
+    FleetRouter,
+    ReplicaTree,
+    federate_metrics,
+)
 from tree_attention_tpu.serving.prefix_cache import (  # noqa: F401
     PagedPrefixIndex,
     PrefixCache,

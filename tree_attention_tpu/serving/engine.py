@@ -276,6 +276,11 @@ class RequestResult:
     completion_s: float  # visible -> finished, wall seconds
     outcome: str  # one of OUTCOMES
     ttft_s: float = 0.0  # visible -> first sampled token, wall seconds
+    # Prompt tokens served from the radix prefix cache at admit (0 = cold
+    # or cache off). Exposed so a front-end can report per-request reuse
+    # upstream — the fleet router's approximate-tree feedback (ISSUE 11)
+    # reads it from the ingress's usage block.
+    prefix_hit_tokens: int = 0
 
 
 @dataclasses.dataclass
@@ -737,6 +742,7 @@ class SlotServer:
         self._slot_admit: List[Tuple[int, float]] = [(0, 0.0)] * slots
         self._slot_state: List[str] = ["free"] * slots
         self._slot_ttft: List[float] = [0.0] * slots
+        self._slot_prefix_hit: List[int] = [0] * slots
         self._prefill_pos: List[int] = [0] * slots
         # Where each slot's prefill STARTED (0 cold, the matched length on
         # a prefix hit) — the first consumed chunk resets the slot's
@@ -1195,6 +1201,13 @@ class SlotServer:
 
     # -- ingress-facing control (thread-safe) ------------------------------
 
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Lifetime radix-cache counters (hits/misses/tokens_reused/...),
+        empty when the cache is off. Public so a fleet bench/test can
+        diff reuse across arms of ONE live serve() run (ServeReport's
+        per-run prefix block only lands when the run drains)."""
+        return {} if self._prefix is None else dict(self._prefix.stats())
+
     def cancel(self, uid: int) -> None:
         """Cancel request ``uid`` (any thread; e.g. a client disconnect).
 
@@ -1438,6 +1451,7 @@ class SlotServer:
         else:
             matched = self._prefix_admit(req, slot, tick)
         self._prefill_start[slot] = matched
+        self._slot_prefix_hit[slot] = matched
         # The request's life as ONE span (admit -> retire; rid in args so
         # a Perfetto query groups every event of one request), plus an
         # admitted instant on the timeline.
@@ -1939,6 +1953,7 @@ class SlotServer:
             completion_s=max(now - visible_at, 0.0),
             outcome=outcome,
             ttft_s=self._slot_ttft[slot],
+            prefix_hit_tokens=self._slot_prefix_hit[slot],
         )
         results.append(result)
         if outcome in (OUTCOME_EOS, OUTCOME_BUDGET):

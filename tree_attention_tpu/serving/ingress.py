@@ -287,6 +287,15 @@ class IngressServer(DaemonHTTPServer):
         path = req.path.split("?", 1)[0].rstrip("/") or "/"
         if method == "POST" and path == "/v1/completions":
             self._completions(req)
+        elif method == "POST" and path == "/admin/drain":
+            # The fleet's drain handshake (ISSUE 11): a router/supervisor
+            # starts this replica's graceful drain remotely — idempotent,
+            # answered before the drain completes (poll /ingress/stats
+            # for engine_done).
+            self.drain()
+            self._reply_counted(req, "drain", 202,
+                                json.dumps({"draining": True}),
+                                "application/json")
         elif method == "GET" and path == "/ingress/stats":
             self._reply_counted(req, "stats", 200,
                                 json.dumps(self._stats(), indent=2),
@@ -305,11 +314,19 @@ class IngressServer(DaemonHTTPServer):
 
     def _stats(self) -> Dict[str, Any]:
         with self._lock:
+            alive = (self._engine_thread is not None
+                     and self._engine_thread.is_alive()
+                     and self._engine_error is None)
             out = {
                 "queue_depth": self._queued,
                 "max_queue": self.max_queue,
                 "draining": self._draining,
                 "engine_done": self._report is not None,
+                "engine_alive": alive,
+                # The rejoin handshake's readiness verdict: a router may
+                # route here iff the replica is admitting (the engine loop
+                # is up and not draining).
+                "ready": alive and not self._draining,
             }
         out["slots"] = self.engine.slots
         out["goodput"] = round(self.engine.slo.goodput(), 4)
@@ -576,6 +593,7 @@ class IngressServer(DaemonHTTPServer):
             "usage": {
                 "prompt_tokens": result.prompt_len,
                 "completion_tokens": len(result.tokens),
+                "prefix_hit_tokens": result.prefix_hit_tokens,
             },
         }, indent=2), "application/json")
 
@@ -616,6 +634,10 @@ def _sse_finish(uid: int, result: RequestResult) -> bytes:
         "usage": {
             "prompt_tokens": result.prompt_len,
             "completion_tokens": len(result.tokens),
+            # Replica-side hit/miss report (ISSUE 11): how much of this
+            # prompt the replica's radix cache actually served — the
+            # router's approximate-tree feedback signal.
+            "prefix_hit_tokens": result.prefix_hit_tokens,
         },
     }) + "\n\n").encode()
 

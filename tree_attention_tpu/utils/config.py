@@ -109,6 +109,12 @@ class RunConfig:
     serve_http: Optional[int] = None  # port (0 = OS-picked, logged)
     max_queue: int = 64      # ingress admission-queue bound (429 past it)
     default_deadline: Optional[float] = None  # seconds; None = no default
+    # Fleet serving (ISSUE 11): N in-process replica engines behind the
+    # cache-aware router.
+    serve_fleet: bool = False
+    replicas: int = 2        # replica engines under --serve-fleet
+    router_port: int = 0     # router HTTP port (0 = OS-picked, logged)
+    affinity: str = "on"     # prefix-affinity routing: on | off
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -360,6 +366,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "not carry their own deadline_s — expired in "
                         "queue they are rejected, expired in flight "
                         "retired with outcome 'deadline'")
+    p.add_argument("--serve-fleet", action="store_true",
+                   default=d.serve_fleet,
+                   help="serve mode: run --replicas in-process replica "
+                        "engines behind the cache-aware HTTP router "
+                        "(prefix-affinity load balancing, SGLang arXiv:"
+                        "2312.07104) instead of one ingress — same "
+                        "OpenAI-compatible POST /v1/completions on the "
+                        "router port; SIGTERM rolls the whole fleet "
+                        "down gracefully")
+    p.add_argument("--replicas", type=int, default=d.replicas,
+                   help="--serve-fleet: replica engine count (each gets "
+                        "its own slots/cache/prefix pool; total capacity "
+                        "scales linearly)")
+    p.add_argument("--router-port", type=int, default=d.router_port,
+                   metavar="PORT",
+                   help="--serve-fleet: router HTTP port (0 picks a "
+                        "free port, logged)")
+    p.add_argument("--affinity", choices=["on", "off"],
+                   default=d.affinity,
+                   help="--serve-fleet: 'on' routes requests to the "
+                        "replica whose radix cache already holds their "
+                        "longest prefix (least-loaded fallback with "
+                        "hysteresis); 'off' is pure least-loaded round-"
+                        "robin — the dilution baseline")
     p.add_argument("--prefix-share", type=float, default=d.prefix_share,
                    help="serve mode: fraction of the synthetic trace's "
                         "requests drawing their prompt head from a shared "
