@@ -691,6 +691,22 @@ def _serving_fleet_record():
     return bench_serving_fleet()
 
 
+def _serving_disagg_record():
+    """Disaggregated prefill/decode (ISSUE 12): a prefill-pool + decode-
+    pool pair over ONE shared paged block pool (DistServe arXiv:
+    2401.09670, Splitwise arXiv:2311.18677) vs the fused engine under a
+    prefill flood, at equal total slots and pool bytes. Decode TBT p99
+    must hold ~flat as prefill arrival rate doubles (interference_ratio
+    ~1) while the fused engine's mixed ticks degrade; handoffs are pure
+    ownership transfer (kv_bytes_moved_total pinned 0), streams parity-
+    gated token-identical, allocators drain to zero. CPU proxy with
+    per-worker time attribution; the isolation structure is the claim.
+    See tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_disagg
+
+    return bench_serving_disagg()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -927,6 +943,7 @@ def _run_suite() -> None:
     run("serving_speculative", _serving_spec_record)
     run("serving_ingress_chaos", _serving_ingress_record)
     run("serving_fleet", _serving_fleet_record)
+    run("serving_disagg", _serving_disagg_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1067,6 +1084,16 @@ def _summarize_record(name, rec):
         roll = rec.get("rolling_restart", {})
         if "dropped_total" in roll:
             out["restart_dropped"] = roll["dropped_total"]
+    if name == "serving_disagg":
+        for arm in ("fused", "disagg"):
+            r = rec.get(arm, {}).get("interference_ratio")
+            if r is not None:
+                out[f"{arm}_interference_ratio"] = r
+        if "isolation_improvement" in rec:
+            out["isolation_improvement"] = rec["isolation_improvement"]
+        moved = rec.get("disagg", {}).get("kv_bytes_moved_total")
+        if moved is not None:
+            out["kv_bytes_moved_total"] = moved
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

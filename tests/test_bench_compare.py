@@ -75,9 +75,53 @@ _SPEC.loader.exec_module(bc)
     ("routed_least_loaded", None),
     ("routed_failover", None),
     ("requeued", None),
+    # Disaggregated serving (ISSUE 12): interference ratios are
+    # smaller-is-better (1.0 = perfect isolation; growth IS the
+    # regression), isolation_improvement is a larger-is-better ratio of
+    # ratios, kv_bytes_moved_total guards exactly (pinned 0), and
+    # handoff counts / queue echoes / pool-split shape are workload
+    # echoes that skip.
+    ("interference_ratio", bc.SMALLER_IS_BETTER),
+    ("interference_ratio_base", bc.SMALLER_IS_BETTER),
+    ("isolation_improvement", bc.LARGER_IS_BETTER),
+    ("kv_bytes_moved_total", bc.EXACT),
+    ("tbt_p99_s", bc.SMALLER_IS_BETTER),
+    ("handoffs", None),
+    ("queue_peak", None),
+    ("blocks_transferred", None),
+    ("prefill_slots", None),
+    ("decode_slots", None),
+    ("residents", None),
+    ("wave_prompt_len", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family
+
+
+def test_compare_flags_disagg_interference_regression():
+    # An interference ratio GROWING is the regression (smaller-better);
+    # handoff counts moving with trace interleaving is not.
+    base = {"serving_disagg": {
+        "disagg": {"interference_ratio": 1.0, "handoffs": 3,
+                   "kv_bytes_moved_total": 0},
+    }}
+    cand = {"serving_disagg": {
+        "disagg": {"interference_ratio": 2.4, "handoffs": 9,
+                   "kv_bytes_moved_total": 0},
+    }}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 1 and "interference_ratio" in regs[0]
+
+
+def test_compare_flags_disagg_bytes_moved_exactly():
+    # Zero-copy is an exact contract: ANY kv_bytes_moved_total change
+    # is a regression, not noise.
+    base = {"serving_disagg": {"disagg": {"kv_bytes_moved_total": 0}}}
+    cand = {"serving_disagg": {"disagg": {"kv_bytes_moved_total": 4096}}}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 1 and "kv_bytes_moved_total" in regs[0]
 
 
 def _rec(**trace):

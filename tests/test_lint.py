@@ -26,6 +26,7 @@ OPS_DECODE = "tree_attention_tpu/ops/decode.py"
 PALLAS = "tree_attention_tpu/ops/pallas_decode.py"
 OBS_FLIGHT = "tree_attention_tpu/obs/flight.py"
 INGRESS = "tree_attention_tpu/serving/ingress.py"
+DISAGG = "tree_attention_tpu/serving/disagg.py"
 
 
 def run(rule, text, path=ENGINE):
@@ -290,6 +291,26 @@ class TestHostSync:
                  path="tree_attention_tpu/bench/serving.py")
         assert fs == []
 
+    def test_disagg_serve_and_tick_helpers_scoped(self):
+        # ISSUE 12: the disaggregated loop joins the host-sync scope —
+        # DisaggServer.serve and any *_tick helper pay exactly one
+        # annotated fetch per worker; adoption/relay helpers are host
+        # bookkeeping on request data and stay out of scope, like the
+        # fused engine's admission helpers.
+        bad = (
+            "import numpy as np\n"
+            "class DisaggServer:\n"
+            "    def serve(self, requests):\n"
+            "        return np.asarray(self.decode.tok)\n"
+            "    def _decode_tick(self):\n"
+            "        return np.asarray(self.decode.tok)\n"
+            "    def _adopt(self, p, d):\n"
+            "        return np.asarray(self.decode.tok)\n"
+        )
+        fs = run("host-sync", bad, path=DISAGG)
+        assert len(fs) == 2
+        assert {f.line for f in fs} == {4, 6}  # serve + _decode_tick
+
 
 # ---------------------------------------------------------------------------
 # recompile-hygiene
@@ -314,6 +335,14 @@ class TestRecompileHygiene:
             "        bucket = prompt.shape[1]\n"
         ))
         assert fs == []
+
+    def test_disagg_shape_vars_scoped(self):
+        # ISSUE 12: the disagg loop builds its own tick matrices — its
+        # tq assignments must flow through the pow2 bucket helpers too.
+        fs = run("recompile-hygiene", "tq = raw_len\n", path=DISAGG)
+        assert len(fs) == 1 and "tq" in fs[0].message
+        assert run("recompile-hygiene",
+                   "tq = dc._chunk_bucket(raw_len)\n", path=DISAGG) == []
 
     def test_module_scope_jnp_flagged(self):
         fs = run("recompile-hygiene", (
@@ -610,6 +639,39 @@ class TestLockSafety:
         ), path="tree_attention_tpu/serving/router.py")
         assert fs == []
 
+    def test_disagg_in_scope_unlocked_mailbox_flagged(self):
+        # ISSUE 12: DisaggServer's cancel/drain mailboxes are its only
+        # thread-safe seams — serving/disagg.py joins the lock-safety
+        # scope (handoff-queue run state lives in loop-locals by design;
+        # whatever shared state DOES live on self mutates under the
+        # RLock).
+        snippet = (
+            "import threading\n"
+            "class DisaggServer:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._cancel_uids = set()\n"
+            "    def cancel(self, uid):\n"
+            "        self._cancel_uids.add(uid)\n"
+        )
+        fs = run("lock-safety", snippet, path=DISAGG)
+        assert len(fs) == 1 and "self._cancel_uids" in fs[0].message
+        # ...and the engine module still is NOT in scope.
+        assert run("lock-safety", snippet, path=ENGINE) == []
+
+    def test_disagg_locked_mailbox_clean(self):
+        fs = run("lock-safety", (
+            "import threading\n"
+            "class DisaggServer:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._draining = False\n"
+            "    def request_drain(self):\n"
+            "        with self._lock:\n"
+            "            self._draining = True\n"
+        ), path=DISAGG)
+        assert fs == []
+
     def test_ingress_locked_mutation_and_condition_lock_clean(self):
         # The live feeder's Condition doubles as its lock; mutations
         # under `with self._lock:` pass, and Condition() on a class with
@@ -667,6 +729,15 @@ class TestFullPackage:
         with open(path) as fh:
             text = fh.read()
         assert text.count("lint: allow[host-sync]") == 2
+
+    def test_disagg_tick_fetches_are_annotated(self):
+        # One fetch per worker per tick, all annotated: the prefill
+        # worker's await fetch, the decode worker's fused-verify fetch,
+        # and the decode worker's plain token fetch (ISSUE 12).
+        path = os.path.join(lintlib.REPO_ROOT, DISAGG)
+        with open(path) as fh:
+            text = fh.read()
+        assert text.count("lint: allow[host-sync]") == 3
 
 
 class TestRunner:

@@ -1,0 +1,432 @@
+"""Disaggregated prefill/decode serving (ISSUE 12).
+
+The hard contracts, pinned here:
+
+- **Token parity with the fused engine** — splitting the phases may only
+  change *where* work runs, never *which* tokens stream: exact and int8,
+  chunked admission, paged layout, speculation on the decode pool, and
+  the shared radix cache (a zero-copy hit must not change tokens either).
+- **Zero-copy handoff** — the allocator-audited ownership transfer moves
+  every block exactly once (``transfer_private`` raises on a cached/free
+  block), reservations transfer rather than re-reserve, and
+  ``ServeReport.handoff`` pins ``kv_bytes_moved == 0``.
+- **One retire path on every arc** — EOS/budget at either worker, cancel
+  mid-prefill, cancel WHILE QUEUED FOR HANDOFF (the new arc this split
+  introduces), deadline, drain-shed: the pair's allocator must drain to
+  0 private / 0 reserved / 0 pins afterwards.
+- **The ingress stacks unchanged** — ``DisaggServer`` exposes the
+  ``SlotServer`` seams, so ``--serve-http`` over a disaggregated pair is
+  the same loopback SSE contract.
+
+Budget discipline (the tier-1 ceiling): ONE module-scoped engine per
+configuration, fused references memoized per shape, every trace tiny
+(d64/v128 model, cache_len 64).
+"""
+
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tree_attention_tpu.models import TransformerConfig, init_params
+from tree_attention_tpu.serving import (
+    BlockAllocator,
+    DisaggServer,
+    Request,
+    SlotServer,
+)
+
+CFG = TransformerConfig(
+    vocab_size=128,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_head=16,
+    d_ff=128,
+    max_seq_len=256,
+    dtype=jnp.float32,
+    attn_impl="blockwise",
+    attn_block_size=16,
+)
+CACHE_LEN = 64
+# Attractor prompts (the spec-test workload: greedy decode of the tiny
+# model settles into a loop, so the n-gram drafter accepts).
+LOOP_PROMPT = np.tile(np.array([7, 9, 4], np.int32), 6)[:16]
+ALT_PROMPT = np.tile(np.array([3, 5], np.int32), 8)
+RAND_PROMPT = np.array(
+    [11, 90, 33, 5, 72, 18, 101, 64, 9, 40, 2, 77], np.int32
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _trace(n_new=12, eos=None):
+    """Three requests with staggered arrivals — enough to exercise
+    admission waits, interleaved prefill/decode, and multiple handoffs
+    through a 1-prefill/2-decode split."""
+    return [
+        Request(uid=0, prompt=LOOP_PROMPT, max_new_tokens=n_new,
+                eos_id=eos),
+        Request(uid=1, prompt=ALT_PROMPT, max_new_tokens=n_new,
+                arrival_tick=2, eos_id=eos),
+        Request(uid=2, prompt=RAND_PROMPT, max_new_tokens=n_new,
+                arrival_tick=4, eos_id=eos),
+    ]
+
+
+_REF_CACHE = {}
+
+
+def _ref_tokens(params, n_new=12, eos=None, **kw):
+    """Fused-engine reference streams, memoized per shape — several
+    parity tests share one reference run (each fresh server pays its
+    own jit compiles; the tier-1 time budget)."""
+    key = (n_new, eos, tuple(sorted(kw.items())))
+    if key not in _REF_CACHE:
+        rep = SlotServer(
+            params, CFG, slots=3, cache_len=CACHE_LEN, prefill_chunk=8,
+            **kw,
+        ).serve(_trace(n_new, eos))
+        _REF_CACHE[key] = {r.uid: r.tokens for r in rep.results}
+    return _REF_CACHE[key]
+
+
+_ENGINES = {}
+
+
+def _disagg(params, name, **kw):
+    """Module-memoized DisaggServer per configuration (serve() is
+    reusable by contract, so one warmed pair serves many tests)."""
+    if name not in _ENGINES:
+        _ENGINES[name] = DisaggServer(
+            params, CFG, prefill_slots=1, decode_slots=2,
+            cache_len=CACHE_LEN, prefill_chunk=8, **kw,
+        )
+    return _ENGINES[name]
+
+
+def assert_drained(server):
+    leak = server.leak_report()
+    assert leak["blocks_private"] == 0, leak
+    assert leak["blocks_reserved"] == 0, leak
+    assert leak["pins"] == 0, leak
+    # The only legitimate occupancy is the radix tree's retained cache.
+    assert leak["blocks_used"] == leak["blocks_cached"], leak
+    assert server.all_slots_free
+
+
+# ---------------------------------------------------------------------------
+# token parity with the fused engine
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    def test_exact_tokens_identical_and_leak_free(self, params):
+        # The main pair runs with the shared radix cache ON from birth:
+        # zero-copy hits must never change tokens, so the same fused
+        # (cache-off) reference pins both properties at once.
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        rep = srv.serve(_trace())
+        assert {r.uid: r.tokens for r in rep.results} == \
+            _ref_tokens(params)
+        assert rep.outcomes == {"budget": 3}
+        assert rep.handoff["handoffs"] == 3
+        assert rep.handoff["kv_bytes_moved"] == 0
+        assert rep.handoff["blocks_transferred"] > 0
+        assert_drained(srv)
+
+    def test_exact_eos_arcs_identical(self, params):
+        # EOS can land on the prefill worker (first token) or the decode
+        # worker (mid-stream) — both must match the fused engine.
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        rep = srv.serve(_trace(n_new=12, eos=9))
+        assert {r.uid: r.tokens for r in rep.results} == \
+            _ref_tokens(params, n_new=12, eos=9)
+        assert_drained(srv)
+
+    def test_shared_radix_hits_across_the_pair(self, params):
+        # A second pass over the same prompts must hit the shared tree
+        # (published by the prefill worker, pins held through decode),
+        # with tokens STILL identical to the cache-off reference.
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        rep = srv.serve(_trace())
+        assert rep.prefix["hits"] == 3
+        assert rep.prefix["tokens_reused"] > 0
+        assert rep.prefix["hit_bytes_moved"] == 0  # reference-in-place
+        assert {r.uid: r.tokens for r in rep.results} == \
+            _ref_tokens(params)
+        assert_drained(srv)
+
+    def test_int8_tokens_identical(self, params):
+        # The handoff's scale transfer (per-slot frozen scales copied
+        # prefill-slot -> decode-slot) is load-bearing here: a wrong or
+        # stale scale diverges the stream immediately.
+        srv = _disagg(params, "int8", quantize=True)
+        rep = srv.serve(_trace())
+        assert {r.uid: r.tokens for r in rep.results} == \
+            _ref_tokens(params, quantize=True)
+        assert_drained(srv)
+
+    def test_speculation_on_decode_pool_parity(self, params):
+        # Speculative decode ticks on the decode pool commit the same
+        # stream as the NON-speculative fused engine (the spec parity
+        # contract, now across the handoff: history buffer and committed
+        # length must transfer correctly for the drafter to work).
+        srv = _disagg(params, "spec", speculate=True, draft_k=4)
+        rep = srv.serve(_trace(n_new=24))
+        assert {r.uid: r.tokens for r in rep.results} == \
+            _ref_tokens(params, n_new=24)
+        # The attractor prompts must actually accept drafts — otherwise
+        # this test silently degrades to plain decode.
+        assert rep.spec["accepted"] > 0
+        assert rep.spec["tokens_per_verify"] > 1.0
+        assert_drained(srv)
+
+
+# ---------------------------------------------------------------------------
+# robustness arcs: every exit leak-free on whichever worker owns it
+# ---------------------------------------------------------------------------
+
+
+class TestExitArcs:
+    def test_cancel_while_queued_for_handoff(self, params):
+        # The arc this PR introduces: both decode slots are held by long
+        # residents, so the victim finishes prefill and PARKS in its
+        # prefill slot awaiting adoption; cancelling it there must
+        # retire through the prefill worker's one retire path with its
+        # single (prefill-sampled) token delivered and nothing leaked.
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        victim_uid = 7
+
+        def cancel_victim(_tok, _srv=srv):
+            _srv.cancel(victim_uid)
+
+        reqs = [
+            Request(uid=0, prompt=LOOP_PROMPT, max_new_tokens=30),
+            Request(uid=1, prompt=ALT_PROMPT, max_new_tokens=30),
+            # Arrives once both residents decode; its first token fires
+            # the cancel (on_token runs on the loop thread; the mailbox
+            # is swept next tick, while the request is still parked —
+            # the residents have 30 tokens to go).
+            Request(uid=victim_uid, prompt=RAND_PROMPT,
+                    max_new_tokens=20, arrival_tick=2,
+                    on_token=cancel_victim),
+        ]
+        rep = srv.serve(reqs)
+        out = {r.uid: r for r in rep.results}
+        assert out[victim_uid].outcome == "cancelled"
+        assert len(out[victim_uid].tokens) == 1  # parked after 1st token
+        assert out[0].outcome == "budget" and out[1].outcome == "budget"
+        # The victim was never adopted: its handoff never completed.
+        assert rep.handoff["handoffs"] == 2
+        assert_drained(srv)
+
+    def test_cancel_mid_prefill_on_prefill_worker(self, params):
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        victim_uid = 9
+        fired = []
+
+        def cancel_once(_tok, _srv=srv):
+            if not fired:
+                fired.append(1)
+                _srv.cancel(victim_uid)
+
+        # The victim's 48-token prompt needs 6 chunk ticks on the
+        # prefill worker; the resident's SECOND token (well inside that
+        # window) cancels it mid-prefill — no token ever streams.
+        long_prompt = np.tile(RAND_PROMPT, 4)
+        reqs = [
+            Request(uid=0, prompt=LOOP_PROMPT, max_new_tokens=20),
+            Request(uid=victim_uid, prompt=long_prompt,
+                    max_new_tokens=8, arrival_tick=3,
+                    on_token=cancel_once),
+        ]
+        # on_token belongs to the victim; use the resident's stream
+        # instead so the cancel fires while the victim prefills.
+        reqs[0].on_token = cancel_once
+        reqs[1].on_token = None
+        rep = srv.serve(reqs)
+        out = {r.uid: r for r in rep.results}
+        assert out[victim_uid].outcome == "cancelled"
+        assert out[victim_uid].tokens == []
+        assert_drained(srv)
+
+    def test_deadline_expired_in_queue_rejected_unserved(self, params):
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        reqs = [
+            Request(uid=0, prompt=LOOP_PROMPT, max_new_tokens=6),
+            Request(uid=1, prompt=ALT_PROMPT, max_new_tokens=6,
+                    deadline_s=time.monotonic() - 1.0),  # already dead
+        ]
+        rep = srv.serve(reqs)
+        out = {r.uid: r for r in rep.results}
+        assert out[1].outcome == "deadline" and out[1].tokens == []
+        assert out[1].admit_tick == -1
+        assert out[0].outcome == "budget"
+        assert_drained(srv)
+
+    def test_drain_sheds_queue_and_finishes_inflight(self, params):
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        fired = []
+
+        def drain_once(_tok, _srv=srv):
+            if not fired:
+                fired.append(1)
+                _srv.request_drain()
+
+        reqs = [
+            Request(uid=0, prompt=LOOP_PROMPT, max_new_tokens=10,
+                    on_token=drain_once),
+            # Visible at the drain tick but unadmitted -> shed unserved.
+            Request(uid=1, prompt=ALT_PROMPT, max_new_tokens=10,
+                    arrival_tick=1),
+        ]
+        rep = srv.serve(reqs)
+        out = {r.uid: r for r in rep.results}
+        assert out[0].outcome == "budget"  # in-flight ran to completion
+        assert len(out[0].tokens) == 10
+        assert out[1].outcome == "shed" and out[1].tokens == []
+        assert_drained(srv)
+
+
+# ---------------------------------------------------------------------------
+# the allocator's transfer audit + construction contracts
+# ---------------------------------------------------------------------------
+
+
+class TestTransferAudit:
+    def test_transfer_private_moves_only_private_blocks(self):
+        alloc = BlockAllocator(4)
+        assert alloc.reserve(2)
+        a, b = alloc.alloc(), alloc.alloc()
+        assert alloc.transfer_private([a, b]) == 2
+        assert alloc.transferred == 2
+        # Ledger state unchanged: still privately owned, still freeable.
+        alloc.free_private(a)
+        alloc.free_private(b)
+        assert alloc.used == 0
+
+    def test_transfer_of_free_block_raises(self):
+        alloc = BlockAllocator(4)
+        with pytest.raises(AssertionError, match="not privately owned"):
+            alloc.transfer_private([0])
+
+    def test_transfer_of_cached_block_raises(self):
+        alloc = BlockAllocator(4)
+        assert alloc.reserve(1)
+        bid = alloc.alloc()
+        alloc.publish(bid)  # tree-owned now
+        with pytest.raises(AssertionError, match="not privately owned"):
+            alloc.transfer_private([bid])
+
+    def test_transfer_keeps_reservations_and_availability(self):
+        alloc = BlockAllocator(8)
+        assert alloc.reserve(4)
+        bids = [alloc.alloc() for _ in range(2)]
+        before = (alloc.available(), alloc.reserved, alloc.gen)
+        alloc.transfer_private(bids)
+        # The handoff invariant: availability, reservations, and the
+        # deferral generation are all untouched.
+        assert (alloc.available(), alloc.reserved, alloc.gen) == before
+
+    def test_engine_rejects_contiguous_shared_pool(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            SlotServer(params, CFG, slots=1, cache_len=CACHE_LEN,
+                       kv_layout="contiguous",
+                       block_pool=BlockAllocator(4))
+
+    def test_engine_rejects_mismatched_kv_blocks(self, params):
+        with pytest.raises(ValueError, match="contradicts"):
+            SlotServer(params, CFG, slots=1, cache_len=CACHE_LEN,
+                       kv_blocks=8, block_pool=BlockAllocator(4))
+
+    def test_disagg_rejects_int8_prefix_sharing(self, params):
+        with pytest.raises(ValueError, match="int8"):
+            DisaggServer(params, CFG, prefill_slots=1, decode_slots=1,
+                         cache_len=CACHE_LEN, quantize=True,
+                         prefix_cache=True)
+
+
+# ---------------------------------------------------------------------------
+# the ingress stacks unchanged on the disaggregated pair
+# ---------------------------------------------------------------------------
+
+
+def test_http_ingress_over_disagg(params):
+    import http.client
+    import json
+
+    from tree_attention_tpu.serving.ingress import IngressServer
+
+    srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+    ing = IngressServer(srv, max_queue=8, default_max_tokens=6,
+                        keepalive_s=0.05)
+    port = ing.start()
+    try:
+        prompt = [int(t) for t in LOOP_PROMPT]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": prompt, "max_tokens": 6,
+                                 "stream": False}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        # Same greedy stream the fused reference produced for this
+        # prompt (uid 0 of the parity trace) — through HTTP, through
+        # the handoff.
+        assert body["choices"][0]["token_ids"] == \
+            _ref_tokens(params)[0][:6]
+        assert body["choices"][0]["finish_reason"] == "length"
+    finally:
+        ing.drain()
+        ing.join(timeout=30)
+        ing.stop()
+    # The drained pair holds nothing.
+    leak = srv.leak_report()
+    assert leak["blocks_private"] == 0 and leak["blocks_reserved"] == 0
+    assert leak["pins"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI flag surface (validation only — no engines built)
+# ---------------------------------------------------------------------------
+
+
+class TestCLIValidation:
+    def _cfg(self, **kw):
+        from tree_attention_tpu.utils.config import RunConfig
+
+        return RunConfig(mode="serve", serve_disagg=True, **kw)
+
+    def test_fleet_exclusive(self):
+        from tree_attention_tpu.cli import _run_serve
+
+        with pytest.raises(SystemExit, match="exclusive"):
+            _run_serve(self._cfg(serve_fleet=True), None)
+
+    def test_requires_paged_layout(self):
+        from tree_attention_tpu.cli import _run_serve
+
+        with pytest.raises(SystemExit, match="paged"):
+            _run_serve(self._cfg(kv_layout="contiguous"), None)
+
+    def test_decode_slots_must_remain(self):
+        from tree_attention_tpu.cli import _run_serve
+
+        with pytest.raises(SystemExit, match="decode slot"):
+            _run_serve(self._cfg(slots=1, prefill_slots=1), None)
+
+    def test_int8_prefix_combo_rejected(self):
+        from tree_attention_tpu.cli import _run_serve
+
+        with pytest.raises(SystemExit, match="frozen scales"):
+            _run_serve(self._cfg(prefix_cache=True, kv_quant="int8"),
+                       None)

@@ -67,7 +67,10 @@ _LARGER_SUBSTRINGS = (
 )
 # Ratio-shaped keys where SMALLER is better (checked before the
 # larger-is-better substrings — "cost" beats "ratio").
-_SMALLER_SUBSTRINGS = ("cost_ratio",)
+# interference_ratio (ISSUE 12): loaded-over-unloaded decode TBT p99 —
+# the disaggregation headline; 1.0 = perfect isolation, growth is the
+# interference the split exists to remove.
+_SMALLER_SUBSTRINGS = ("cost_ratio", "interference_ratio")
 _EXACT_SUFFIXES = ("_total", "_bytes", "_count")
 _SMALLER_SUFFIXES = ("_us", "_s", "_seconds", "_ms")
 _SMALLER_EXACT_KEYS = ("median", "mean", "wall_s", "p50", "p95", "p99")
@@ -99,6 +102,12 @@ _IGNORE_KEYS = frozenset((
     "replicas", "slots_per_replica", "kv_blocks_per_replica", "tenants",
     "tenant_prefix_len", "deadline_calib_s", "routed_affinity",
     "routed_least_loaded", "routed_failover", "requeued",
+    # Disaggregated serving record (ISSUE 12): handoff counts and queue
+    # echoes vary with trace interleaving, not performance — the guarded
+    # metrics of that family are the tbt p99 keys, interference_ratio
+    # (smaller-better), and the exact kv_bytes_moved (pinned 0).
+    "prefill_slots", "decode_slots", "handoffs", "queue_peak",
+    "blocks_transferred", "residents", "waves", "wave_prompt_len",
 ))
 
 

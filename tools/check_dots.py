@@ -38,9 +38,12 @@ import sys
 # scope fixtures; 463 measured), 512 after PR 10 (prefix-affinity fleet:
 # router scoring/tree/federation units + loopback fleet integration +
 # router/fleet hardening regression tests + lock-safety router/fleet
-# scope fixtures + bench_compare fleet families; 513 measured). Raise
-# as PRs add tests.
-FLOOR = 512
+# scope fixtures + bench_compare fleet families; 513 measured), 552
+# after PR 12 (disaggregated prefill/decode: parity/exit-arc/transfer-
+# audit/ingress-composition suite in tests/test_serving_disagg.py +
+# lock-safety/host-sync/recompile disagg scope fixtures + bench_compare
+# disagg families; 553 measured). Raise as PRs add tests.
+FLOOR = 552
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

@@ -69,6 +69,17 @@ def _scoped_functions(src: Source) -> List[ast.FunctionDef]:
             for fn in cls.body
             if isinstance(fn, ast.FunctionDef) and fn.name == "serve"
         ]
+    if src.path == "tree_attention_tpu/serving/disagg.py":
+        # The disaggregated tick loop (ISSUE 12): each worker pays its
+        # one per-tick fetch inside DisaggServer.serve (and any helper
+        # spelled *_tick); everything else — adoption, relays, admission
+        # — is host bookkeeping that must not touch device buffers.
+        return [
+            fn for cls in src.tree.body if isinstance(cls, ast.ClassDef)
+            for fn in cls.body
+            if isinstance(fn, ast.FunctionDef)
+            and (fn.name == "serve" or fn.name.endswith("_tick"))
+        ]
     if src.path in ("tree_attention_tpu/ops/decode.py",
                     "tree_attention_tpu/ops/__init__.py"):
         return [fn for fn in src.tree.body

@@ -36,7 +36,13 @@ replica registry, approximate trees, and in-flight counters) and
 handles and restart budgets with the caller thread). The engine itself
 stays out of scope by design: handler threads reach it only through the
 three mailbox seams (``submit``/``cancel``/``request_drain``), so all
-other ``SlotServer`` state remains single-threaded.
+other ``SlotServer`` state remains single-threaded. Since ISSUE 12
+``serving/disagg.py`` is in scope too: ``DisaggServer`` mirrors the
+engine's mailbox contract (cancel/drain state under ``self._lock``, an
+RLock — drain may flip from a SIGTERM handler), and the pass enforces
+that everything else it owns — the handoff queue's run state — stays
+either under the lock or deliberately OFF ``self`` (loop-locals that die
+with the run).
 """
 
 from __future__ import annotations
@@ -67,6 +73,7 @@ def _in_scope(path: str) -> bool:
                 "tree_attention_tpu/serving/ingress.py",
                 "tree_attention_tpu/serving/router.py",
                 "tree_attention_tpu/serving/fleet.py",
+                "tree_attention_tpu/serving/disagg.py",
             ))
 
 

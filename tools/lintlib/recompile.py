@@ -71,7 +71,8 @@ def _bucket_ok(expr: ast.AST) -> bool:
 
 
 def _check_shape_vars(src: Source, findings: List[Finding]) -> None:
-    if src.path != "tree_attention_tpu/serving/engine.py":
+    if src.path not in ("tree_attention_tpu/serving/engine.py",
+                        "tree_attention_tpu/serving/disagg.py"):
         return
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.Assign):

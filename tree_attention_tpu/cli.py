@@ -489,6 +489,40 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         )
     if cfg.serve_fleet and cfg.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
+    if cfg.serve_disagg:
+        if cfg.serve_fleet:
+            raise SystemExit(
+                "--serve-disagg and --serve-fleet are exclusive (a "
+                "disaggregated fleet tier is not built yet; run one "
+                "disaggregated pair per process)"
+            )
+        if cfg.kv_layout != "paged":
+            raise SystemExit(
+                "--serve-disagg requires --kv-layout paged: the zero-"
+                "copy handoff IS paged-block ownership transfer"
+            )
+        if cfg.admission != "chunked":
+            raise SystemExit(
+                "--serve-disagg requires --admission chunked (the "
+                "prefill pool is a chunked-prefill worker)"
+            )
+        if cfg.prefill_slots < 1:
+            raise SystemExit("--prefill-slots must be >= 1")
+        decode_slots = (cfg.decode_slots if cfg.decode_slots is not None
+                        else cfg.slots - cfg.prefill_slots)
+        if decode_slots < 1:
+            raise SystemExit(
+                f"--serve-disagg needs >= 1 decode slot: --slots "
+                f"{cfg.slots} minus --prefill-slots {cfg.prefill_slots} "
+                f"leaves {decode_slots} (pass --decode-slots or raise "
+                f"--slots)"
+            )
+        if cfg.prefix_cache and cfg.kv_quant != "none":
+            raise SystemExit(
+                "--serve-disagg cannot combine --prefix-cache with "
+                "--kv-quant: int8 blocks carry per-slot frozen scales "
+                "and cannot be shared across the worker pair"
+            )
     if cfg.default_deadline is not None and cfg.default_deadline <= 0:
         raise SystemExit("--default-deadline must be > 0 seconds")
     if cfg.speculate and cfg.temperature != 0.0:
@@ -616,7 +650,18 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         drafter=drafter,
     )
 
-    def make_engine() -> SlotServer:
+    def make_engine():
+        if cfg.serve_disagg:
+            # The disaggregated pair (ISSUE 12): same seams as a fused
+            # SlotServer, so the ingress below works unchanged on top.
+            from tree_attention_tpu.serving.disagg import DisaggServer
+
+            disagg_kw = {k: v for k, v in engine_kw.items()
+                         if k not in ("slots", "admission", "kv_layout")}
+            return DisaggServer(
+                params, tcfg, prefill_slots=cfg.prefill_slots,
+                decode_slots=decode_slots, **disagg_kw,
+            )
         return SlotServer(params, tcfg, **engine_kw)
 
     from tree_attention_tpu.host_runtime import heartbeat
@@ -725,6 +770,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             "slots": cfg.slots,
             "cache_len": cache_len,
             "kv_layout": cfg.kv_layout,
+            **({"disagg": {"prefill_slots": cfg.prefill_slots,
+                           "decode_slots": decode_slots}}
+               if cfg.serve_disagg else {}),
             **(report.as_dict() if report is not None else {}),
         })
         return 0
@@ -756,6 +804,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         "admission": cfg.admission,
         "prefill_chunk": cfg.prefill_chunk,
         "kv_layout": cfg.kv_layout,
+        **({"disagg": {"prefill_slots": cfg.prefill_slots,
+                       "decode_slots": decode_slots}}
+           if cfg.serve_disagg else {}),
         **({"speculate": {"draft_k": cfg.draft_k, "drafter": cfg.drafter}}
            if cfg.speculate else {}),
         **({"prefix_cache": {

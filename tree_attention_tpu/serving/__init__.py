@@ -20,6 +20,7 @@ from tree_attention_tpu.serving.engine import (  # noqa: F401
     synthetic_trace,
 )
 from tree_attention_tpu.serving.block_pool import BlockAllocator  # noqa: F401
+from tree_attention_tpu.serving.disagg import DisaggServer  # noqa: F401
 from tree_attention_tpu.serving.fleet import (  # noqa: F401
     FleetSupervisor,
     LocalReplica,

@@ -35,7 +35,7 @@ hundreds of random admit/retire/hit/evict interleavings per second.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, Iterable, List, Optional
 
 from tree_attention_tpu import obs
 from tree_attention_tpu.utils.logging import get_logger
@@ -80,6 +80,9 @@ class BlockAllocator:
         # recount until the counter moves — pool state can't have
         # improved in between.
         self.gen = 0
+        # Lifetime count of blocks handed between slot tables via
+        # :meth:`transfer_private` (disaggregation accounting).
+        self.transferred = 0
         self._evict_one: Optional[Callable[[], bool]] = None
         self._evictable: Optional[Callable[[], int]] = None
 
@@ -187,6 +190,36 @@ class BlockAllocator:
         self._state[bid] = _FREE
         self._free.append(bid)
         self.reserved += 1
+
+    def transfer_private(self, bids: Iterable[int]) -> int:
+        """Audited ownership handoff of private blocks between slot
+        tables (disaggregated serving: a prefill worker's finished slot
+        hands its block set to a decode worker, which adopts them into
+        its own table — zero KV bytes moved; DistServe, arXiv:2401.09670).
+
+        The ledger state does not change — each block stays ``_PRIVATE``,
+        owned by exactly one slot before AND after (the callers move the
+        slot-side bookkeeping: table row, private set, and the unspent
+        reservation, which stays counted in :attr:`reserved` throughout).
+        Net availability is therefore untouched — no generation bump, and
+        the reservation-soundness invariant (every future alloc backed by
+        free + evictable blocks) holds across the handoff by construction.
+        The audit is the point: transferring a block that is *not*
+        privately owned (double handoff, a cached block still owned by
+        the radix tree, a freed block) is the ownership bug this ledger
+        exists to catch, and raises here instead of corrupting the pool.
+        Returns the number of blocks transferred."""
+        n = 0
+        for bid in bids:
+            if self._state[bid] != _PRIVATE:
+                raise AssertionError(
+                    f"block {bid} transferred while not privately owned "
+                    f"(state {self._state[bid]}) — handoff of a cached/"
+                    f"free block would double-own it"
+                )
+            n += 1
+        self.transferred += n
+        return n
 
     def free_cached(self, bid: int) -> None:
         """The radix tree evicts a refcount-0 leaf's block."""

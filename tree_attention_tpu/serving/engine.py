@@ -303,6 +303,10 @@ class ServeReport:
     # Speculative-decoding accounting for THIS run (proposed/accepted
     # draft tokens, acceptance_rate, verify ticks); empty when off.
     spec: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Disaggregated prefill/decode accounting (ISSUE 12): handoff counts,
+    # queue peak, blocks transferred, kv_bytes_moved (pinned 0 in-process)
+    # — empty for a fused engine.
+    handoff: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -354,6 +358,7 @@ class ServeReport:
             **({"prefix": self.prefix} if self.prefix else {}),
             **({"kv": self.kv} if self.kv else {}),
             **({"spec": self.spec} if self.spec else {}),
+            **({"handoff": self.handoff} if self.handoff else {}),
         }
 
 
@@ -588,6 +593,23 @@ class SlotServer:
         Tree proposals fall back to their root-path chain on the one
         topology without mask plumbing (contiguous layout on a >1-way
         seq mesh).
+      block_pool: bring-your-own :class:`BlockAllocator` (disaggregated
+        serving, ISSUE 12: two engines — a prefill worker and a decode
+        worker — share ONE pool ledger so a finished prefill's blocks
+        hand over by pure ownership transfer). Paged layout only;
+        ``kv_blocks`` defaults to (and must equal) the pool's capacity.
+        The DEVICE pool arrays are shared by the orchestrator
+        (:class:`~tree_attention_tpu.serving.disagg.DisaggServer`
+        rebinds both caches to one array set and relays after every
+        dispatch); this engine still allocates its own transient
+        initial arrays, which the rebind immediately frees.
+      prefix_index: bring-your-own
+        :class:`~tree_attention_tpu.serving.prefix_cache
+        .PagedPrefixIndex` over ``block_pool`` (the disaggregated pair
+        shares one radix tree: the prefill worker matches/adopts, the
+        decode worker holds the request's pins until retire). Implies
+        the prefix cache is on; exact paged serving only, and the
+        index's block size must equal ``kv_block``.
     """
 
     def __init__(
@@ -617,6 +639,8 @@ class SlotServer:
         speculate: bool = False,
         draft_k: int = 4,
         drafter: Union[str, Drafter, None] = None,
+        block_pool: Optional[BlockAllocator] = None,
+        prefix_index: Optional[Any] = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -628,6 +652,11 @@ class SlotServer:
             raise ValueError(
                 f"kv_layout must be 'paged' or 'contiguous', "
                 f"got {kv_layout!r}"
+            )
+        if block_pool is not None and kv_layout != "paged":
+            raise ValueError(
+                "block_pool sharing requires kv_layout='paged' (the "
+                "contiguous layout has no block ledger to share)"
             )
         if prefill_chunk < 1:
             raise ValueError(
@@ -709,10 +738,24 @@ class SlotServer:
                 )
             self.kv_block = kv_block
             self._npb = -(-cache_len // kv_block)  # table width (blocks)
-            self.kv_blocks = (
-                slots * self._npb if kv_blocks is None else kv_blocks
-            )
-            self._pool = BlockAllocator(self.kv_blocks)
+            if block_pool is not None:
+                # Shared-pool mode (disaggregation): the allocator is the
+                # ONE ledger both workers admit/retire against, so this
+                # engine's view of capacity must be the pool's — a
+                # different kv_blocks would let _validate accept requests
+                # the shared pool can never hold (or reject ones it can).
+                if kv_blocks is not None and kv_blocks != block_pool.blocks:
+                    raise ValueError(
+                        f"kv_blocks {kv_blocks} contradicts the shared "
+                        f"block_pool's capacity {block_pool.blocks}"
+                    )
+                self.kv_blocks = block_pool.blocks
+                self._pool = block_pool
+            else:
+                self.kv_blocks = (
+                    slots * self._npb if kv_blocks is None else kv_blocks
+                )
+                self._pool = BlockAllocator(self.kv_blocks)
             self._host_table = np.zeros((slots, self._npb), np.int32)
             self._table_dirty = False  # device table starts all-zero too
             self._slot_nblocks = [0] * slots
@@ -794,7 +837,32 @@ class SlotServer:
         self._tick_prefix_hits = 0
         self._tick_prefix_reused = 0
         self._hit_bytes_moved = 0
-        if prefix_cache:
+        if prefix_index is not None:
+            # Shared-radix mode (disaggregation): both workers hold pins
+            # in ONE tree — the prefill worker matches and adopts, the
+            # decode worker inherits the request's pins at handoff and
+            # releases them at retire. Only the exact paged index can be
+            # shared (int8 blocks carry per-slot frozen scales, and the
+            # contiguous gather pool owns its own device buffers).
+            if not self._paged or quantize:
+                raise ValueError(
+                    "prefix_index sharing requires exact paged serving "
+                    "(kv_layout='paged', quantize=False)"
+                )
+            if block_pool is None or prefix_index.alloc is not block_pool:
+                raise ValueError(
+                    "prefix_index must be built over the same shared "
+                    "block_pool (one ledger, one tree)"
+                )
+            if prefix_index.block != self.kv_block:
+                raise ValueError(
+                    f"prefix_index block {prefix_index.block} must equal "
+                    f"kv_block {self.kv_block} (radix matching happens at "
+                    f"page granularity)"
+                )
+            self._prefix = prefix_index
+            self._paged_prefix = True
+        elif prefix_cache:
             if prefix_block > cache_len:
                 # Checked before the pool allocates: a block wider than a
                 # slot could never be copied anywhere.

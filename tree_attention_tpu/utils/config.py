@@ -115,6 +115,12 @@ class RunConfig:
     replicas: int = 2        # replica engines under --serve-fleet
     router_port: int = 0     # router HTTP port (0 = OS-picked, logged)
     affinity: str = "on"     # prefix-affinity routing: on | off
+    # Disaggregated prefill/decode (ISSUE 12): split-phase engine pools
+    # over one shared block pool, zero-copy KV handoff.
+    serve_disagg: bool = False
+    prefill_slots: int = 1   # prefill-pool slots under --serve-disagg
+    decode_slots: Optional[int] = None  # decode-pool slots (None ->
+    #                                     slots - prefill_slots)
 
     # Host data pipeline (train mode).
     host_data: bool = False
@@ -390,6 +396,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "longest prefix (least-loaded fallback with "
                         "hysteresis); 'off' is pure least-loaded round-"
                         "robin — the dilution baseline")
+    p.add_argument("--serve-disagg", action="store_true",
+                   default=d.serve_disagg,
+                   help="serve mode: disaggregated prefill/decode "
+                        "(DistServe arXiv:2401.09670, Splitwise arXiv:"
+                        "2311.18677) — a prefill pool (--prefill-slots) "
+                        "runs admission + chunked prefill only and hands "
+                        "finished requests to a decode pool "
+                        "(--decode-slots) by zero-copy paged-block "
+                        "ownership transfer over ONE shared --kv-blocks "
+                        "pool; decode ticks never carry prefill rows, so "
+                        "TBT stops paying for admission storms. "
+                        "Composable with --serve-http (the ingress "
+                        "drives the disaggregated pair unchanged); "
+                        "paged layout only")
+    p.add_argument("--prefill-slots", type=int, default=d.prefill_slots,
+                   help="--serve-disagg: prefill-pool slot count "
+                        "(prompts concurrently in chunked prefill or "
+                        "parked for handoff)")
+    p.add_argument("--decode-slots", type=int, default=d.decode_slots,
+                   help="--serve-disagg: decode-pool slot count "
+                        "(default: --slots minus --prefill-slots, so "
+                        "--slots stays the total-capacity knob)")
     p.add_argument("--prefix-share", type=float, default=d.prefix_share,
                    help="serve mode: fraction of the synthetic trace's "
                         "requests drawing their prompt head from a shared "
