@@ -707,6 +707,24 @@ def _serving_disagg_record():
     return bench_serving_disagg()
 
 
+def _serving_tiered_record():
+    """Hierarchical KV cache (ISSUE 13): a host-RAM demotion tier under
+    the device pool (SGLang's hierarchical-cache direction over
+    RadixAttention arXiv:2312.07104) on a multi-prefix flood whose KV
+    population overflows the device pool — pass-2 hit-rate and TTFT p50
+    with tiering on must hold near the fits-in-device ceiling while
+    tiering off re-pays cold prefill — plus int8 per-block-scale
+    capacity: max concurrent requests at equal device pool bytes, int8
+    vs exact (~the bytes ratio, now that int8 blocks share through the
+    radix tree). Token-parity-gated across the tiering arms; both
+    allocators (device AND host) checked drained. CPU proxy; the
+    hit-rate/capacity structure transfers. See
+    tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_tiered_kv
+
+    return bench_serving_tiered_kv()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -944,6 +962,7 @@ def _run_suite() -> None:
     run("serving_ingress_chaos", _serving_ingress_record)
     run("serving_fleet", _serving_fleet_record)
     run("serving_disagg", _serving_disagg_record)
+    run("serving_tiered_kv", _serving_tiered_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1094,6 +1113,15 @@ def _summarize_record(name, rec):
         moved = rec.get("disagg", {}).get("kv_bytes_moved_total")
         if moved is not None:
             out["kv_bytes_moved_total"] = moved
+    if name == "serving_tiered_kv":
+        tier = rec.get("tiering", {})
+        for key in ("hit_rate_improvement", "ttft_p50_improvement",
+                    "restore_ratio"):
+            if key in tier:
+                out[key] = tier[key]
+        cc = rec.get("int8_capacity", {}).get("max_concurrent_improvement")
+        if cc is not None:
+            out["int8_max_concurrent_improvement"] = cc
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

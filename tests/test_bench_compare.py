@@ -93,6 +93,24 @@ _SPEC.loader.exec_module(bc)
     ("decode_slots", None),
     ("residents", None),
     ("wave_prompt_len", None),
+    # Hierarchical KV (ISSUE 13): hit-rate / restore-ratio and the
+    # improvement ratios are larger-is-better quality metrics; tier
+    # shape and demotion-traffic counts are workload echoes that skip.
+    ("hit_rate", bc.LARGER_IS_BETTER),
+    ("hit_rate_improvement", bc.LARGER_IS_BETTER),
+    ("restore_ratio", bc.LARGER_IS_BETTER),
+    ("max_concurrent_improvement", bc.LARGER_IS_BETTER),
+    ("ttft_p50_vs_ceiling", None),
+    ("host_blocks", None),
+    ("host_blocks_used", None),
+    ("demotions", None),
+    ("restores", None),
+    ("host_drops", None),
+    ("restored_blocks", None),
+    ("device_pool_blocks", None),
+    ("prefix_population_blocks", None),
+    ("pool_blocks_int8", None),
+    ("bytes_ratio", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family
@@ -122,6 +140,25 @@ def test_compare_flags_disagg_bytes_moved_exactly():
     regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
                          rtol_exact=0.0)
     assert len(regs) == 1 and "kv_bytes_moved_total" in regs[0]
+
+
+def test_compare_flags_tiered_hit_rate_collapse():
+    # The host tier's whole point is holding pass-2 hit-rate at the
+    # ceiling: a collapse IS the regression; demotion-traffic counts
+    # moving with trace interleaving is not.
+    base = {"serving_tiered_kv": {"tiering": {
+        "hit_rate_improvement": 5.0, "restore_ratio": 0.8,
+        "demotions": 40, "restores": 32, "host_drops": 0,
+    }}}
+    cand = {"serving_tiered_kv": {"tiering": {
+        "hit_rate_improvement": 1.0, "restore_ratio": 0.1,
+        "demotions": 90, "restores": 9, "host_drops": 12,
+    }}}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 2
+    assert any("hit_rate_improvement" in r for r in regs)
+    assert any("restore_ratio" in r for r in regs)
 
 
 def _rec(**trace):

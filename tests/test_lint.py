@@ -27,6 +27,7 @@ PALLAS = "tree_attention_tpu/ops/pallas_decode.py"
 OBS_FLIGHT = "tree_attention_tpu/obs/flight.py"
 INGRESS = "tree_attention_tpu/serving/ingress.py"
 DISAGG = "tree_attention_tpu/serving/disagg.py"
+HOST_POOL = "tree_attention_tpu/serving/host_pool.py"
 
 
 def run(rule, text, path=ENGINE):
@@ -311,6 +312,43 @@ class TestHostSync:
         assert len(fs) == 2
         assert {f.line for f in fs} == {4, 6}  # serve + _decode_tick
 
+    def test_host_pool_every_method_scoped(self):
+        # ISSUE 13: the host KV tier is the ONE intended home of host
+        # sync (the staged D2H batch lands in commit()), so EVERY
+        # HostBlockPool method is in scope and each landing fetch needs
+        # its annotated reason — a bare fetch anywhere in the file is a
+        # staging-discipline bug, not background noise.
+        bad = (
+            "import numpy as np\n"
+            "class HostBlockPool:\n"
+            "    def commit(self, rows, k_rows):\n"
+            "        self.k[rows] = np.asarray(k_rows)\n"
+            "    def read(self, rows):\n"
+            "        return np.asarray(self.k[rows])\n"
+        )
+        fs = run("host-sync", bad, path=HOST_POOL)
+        assert len(fs) == 2
+        fs = run("host-sync", bad.replace(
+            "        self.k[rows] = np.asarray(k_rows)\n",
+            "        # lint: allow[host-sync] the staged D2H batch "
+            "lands here\n"
+            "        self.k[rows] = np.asarray(k_rows)\n",
+        ), path=HOST_POOL)
+        assert len(fs) == 1 and fs[0].line == 7  # only the bare read
+
+    def test_host_pool_bookkeeping_clean(self):
+        # The real class's sync-free surface (alloc/enqueue/drop is pure
+        # host bookkeeping) must stay clean without annotations.
+        fs = run("host-sync", (
+            "import numpy as np\n"
+            "class HostBlockPool:\n"
+            "    def alloc(self):\n"
+            "        return self._free.pop() if self._free else None\n"
+            "    def enqueue(self, row, bid):\n"
+            "        self.pending[row] = bid\n"
+        ), path=HOST_POOL)
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # recompile-hygiene
@@ -514,6 +552,32 @@ class TestLockSafety:
             "        self.enabled = True\n"  # the lock-free fast-path flag
         ), path=OBS_FLIGHT)
         assert fs == []
+
+    def test_host_pool_in_lock_scope(self):
+        # ISSUE 13: host_pool.py joins the lock-safety scope. The real
+        # HostBlockPool is single-threaded (engine-loop only) and owns
+        # no lock — vacuously clean — but the moment anyone gives it one
+        # (say, a background flusher thread), every self._* mutation
+        # must move under it.
+        locked = (
+            "import threading\n"
+            "class HostBlockPool:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._free = []\n"
+            "    def release(self, row):\n"
+            "        self._free.append(row)\n"
+        )
+        fs = run("lock-safety", locked, path=HOST_POOL)
+        assert len(fs) == 1 and "self._free" in fs[0].message
+        lockless = (
+            "class HostBlockPool:\n"
+            "    def __init__(self):\n"
+            "        self._free = []\n"
+            "    def release(self, row):\n"
+            "        self._free.append(row)\n"
+        )
+        assert run("lock-safety", lockless, path=HOST_POOL) == []
 
     def test_plain_lock_on_crash_path_flagged(self):
         base = (

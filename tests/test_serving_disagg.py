@@ -164,11 +164,29 @@ class TestParity:
         assert_drained(srv)
 
     def test_int8_tokens_identical(self, params):
-        # The handoff's scale transfer (per-slot frozen scales copied
-        # prefill-slot -> decode-slot) is load-bearing here: a wrong or
-        # stale scale diverges the stream immediately.
+        # The per-dispatch scale relay (per-BLOCK scales are POOL state,
+        # ISSUE 13) is load-bearing here: a stale scale array on either
+        # worker diverges the stream immediately.
         srv = _disagg(params, "int8", quantize=True)
         rep = srv.serve(_trace())
+        assert {r.uid: r.tokens for r in rep.results} == \
+            _ref_tokens(params, quantize=True)
+        assert_drained(srv)
+
+    def test_int8_shared_radix_hits_across_the_pair(self, params):
+        # int8 blocks share through the pair's ONE radix tree (ISSUE 13:
+        # per-block scales make a published block self-contained) — the
+        # combination PR 12 had to ban. Second pass hits; tokens still
+        # match the cache-off int8 reference.
+        srv = _disagg(params, "int8_prefix", quantize=True,
+                      prefix_cache=True, prefix_block=8)
+        srv.serve(_trace())  # publish pass
+        rep = srv.serve(_trace())  # hit pass
+        assert rep.prefix["hits"] == 3
+        assert rep.prefix["tokens_reused"] > 0
+        # int8 hits dequant-gather the matched blocks into staging —
+        # nonzero bytes, unlike the exact reference-in-place hit.
+        assert rep.prefix["hit_bytes_moved"] > 0
         assert {r.uid: r.tokens for r in rep.results} == \
             _ref_tokens(params, quantize=True)
         assert_drained(srv)
@@ -346,11 +364,10 @@ class TestTransferAudit:
             SlotServer(params, CFG, slots=1, cache_len=CACHE_LEN,
                        kv_blocks=8, block_pool=BlockAllocator(4))
 
-    def test_disagg_rejects_int8_prefix_sharing(self, params):
-        with pytest.raises(ValueError, match="int8"):
+    def test_disagg_tiering_requires_prefix_cache(self, params):
+        with pytest.raises(ValueError, match="prefix_cache"):
             DisaggServer(params, CFG, prefill_slots=1, decode_slots=1,
-                         cache_len=CACHE_LEN, quantize=True,
-                         prefix_cache=True)
+                         cache_len=CACHE_LEN, host_blocks=8)
 
 
 # ---------------------------------------------------------------------------
@@ -424,9 +441,8 @@ class TestCLIValidation:
         with pytest.raises(SystemExit, match="decode slot"):
             _run_serve(self._cfg(slots=1, prefill_slots=1), None)
 
-    def test_int8_prefix_combo_rejected(self):
+    def test_tiering_requires_prefix_cache(self):
         from tree_attention_tpu.cli import _run_serve
 
-        with pytest.raises(SystemExit, match="frozen scales"):
-            _run_serve(self._cfg(prefix_cache=True, kv_quant="int8"),
-                       None)
+        with pytest.raises(SystemExit, match="prefix-cache"):
+            _run_serve(self._cfg(host_blocks=8), None)

@@ -545,13 +545,21 @@ def test_paged_obs_gauges_and_flight(params):
 
 
 def test_paged_cli_flags_parse():
-    """The new flags parse and the deprecated one still exists."""
+    """The paged/tiering flags parse; the PR-6-deprecated
+    --prefix-pool-blocks alias is GONE (ISSUE 13) — --kv-blocks and
+    --host-blocks express both budgets now."""
+    import pytest
+
     from tree_attention_tpu.utils.config import parse_args
 
     cfg = parse_args(["--mode", "serve", "--kv-layout", "contiguous",
-                      "--kv-block", "32", "--kv-blocks", "64",
-                      "--prefix-pool-blocks", "8"])
+                      "--kv-block", "32", "--kv-blocks", "64"])
     assert cfg.kv_layout == "contiguous"
     assert cfg.kv_block == 32 and cfg.kv_blocks == 64
-    assert cfg.prefix_pool_blocks == 8
+    cfg = parse_args(["--mode", "serve", "--host-blocks", "16",
+                      "--kv-tiering", "off"])
+    assert cfg.host_blocks == 16 and cfg.kv_tiering == "off"
     assert parse_args(["--mode", "serve"]).kv_layout == "paged"
+    assert parse_args(["--mode", "serve"]).kv_tiering == "on"
+    with pytest.raises(SystemExit):
+        parse_args(["--mode", "serve", "--prefix-pool-blocks", "8"])

@@ -64,6 +64,10 @@ _LARGER_SUBSTRINGS = (
     # Fleet routing family (ISSUE 11): the share of routed requests the
     # affinity rule placed — a routing-quality ratio, larger is better.
     "affinity_share",
+    # Hierarchical KV family (ISSUE 13): the fraction of demoted blocks
+    # the trace came back for (hit_rate itself classifies above) — a
+    # tier-effectiveness ratio, larger is better.
+    "restore_ratio",
 )
 # Ratio-shaped keys where SMALLER is better (checked before the
 # larger-is-better substrings — "cost" beats "ratio").
@@ -108,6 +112,15 @@ _IGNORE_KEYS = frozenset((
     # (smaller-better), and the exact kv_bytes_moved (pinned 0).
     "prefill_slots", "decode_slots", "handoffs", "queue_peak",
     "blocks_transferred", "residents", "waves", "wave_prompt_len",
+    # Hierarchical KV record (ISSUE 13): tier shape and demotion-traffic
+    # counts vary with trace interleaving and pool geometry, not
+    # performance — the guarded metrics of that family are hit_rate /
+    # restore_ratio / the improvement ratios (larger-better) and the
+    # TTFT keys, which classify through the standard rules.
+    "host_blocks", "host_blocks_used", "demotions", "restores",
+    "host_drops", "restored_blocks", "device_pool_blocks",
+    "prefix_population_blocks", "pool_blocks_exact", "pool_blocks_int8",
+    "bytes_ratio",
 ))
 
 

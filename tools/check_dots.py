@@ -42,8 +42,14 @@ import sys
 # after PR 12 (disaggregated prefill/decode: parity/exit-arc/transfer-
 # audit/ingress-composition suite in tests/test_serving_disagg.py +
 # lock-safety/host-sync/recompile disagg scope fixtures + bench_compare
-# disagg families; 553 measured). Raise as PRs add tests.
-FLOOR = 552
+# disagg families; 553 measured), 601 after PR 13 (hierarchical KV
+# tiering: host-pool/allocator-demoted-state units, bit-exact staging
+# round trips, engine-free radix tier transitions, hit-vs-cold parity
+# across forced demote/restore cycles exact+int8+cpu_mesh, per-block-
+# scale kernel oracles, lint host_pool scope fixtures, bench_compare
+# tiered families, disagg int8 shared-radix parity; 603 measured).
+# Raise as PRs add tests.
+FLOOR = 601
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

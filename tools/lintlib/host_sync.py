@@ -80,6 +80,16 @@ def _scoped_functions(src: Source) -> List[ast.FunctionDef]:
             if isinstance(fn, ast.FunctionDef)
             and (fn.name == "serve" or fn.name.endswith("_tick"))
         ]
+    if src.path == "tree_attention_tpu/serving/host_pool.py":
+        # The host KV tier (ISSUE 13) is the ONE place host sync is
+        # intended — the staged D2H demotion batch lands in commit() —
+        # so every method is in scope and each landing fetch must carry
+        # its annotated reason; anything else touching device buffers
+        # here (reads, alloc bookkeeping) is a staging-discipline bug.
+        return [
+            fn for cls in src.tree.body if isinstance(cls, ast.ClassDef)
+            for fn in cls.body if isinstance(fn, ast.FunctionDef)
+        ]
     if src.path in ("tree_attention_tpu/ops/decode.py",
                     "tree_attention_tpu/ops/__init__.py"):
         return [fn for fn in src.tree.body

@@ -74,6 +74,12 @@ def _in_scope(path: str) -> bool:
                 "tree_attention_tpu/serving/router.py",
                 "tree_attention_tpu/serving/fleet.py",
                 "tree_attention_tpu/serving/disagg.py",
+                # The host KV tier (ISSUE 13): single-threaded by design
+                # today (engine-loop only), so HostBlockPool owns no
+                # lock — but the pass scopes it so the moment anyone
+                # adds one (e.g. a background flusher thread), every
+                # self._* mutation must move under it.
+                "tree_attention_tpu/serving/host_pool.py",
             ))
 
 

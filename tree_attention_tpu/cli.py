@@ -517,12 +517,6 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
                 f"leaves {decode_slots} (pass --decode-slots or raise "
                 f"--slots)"
             )
-        if cfg.prefix_cache and cfg.kv_quant != "none":
-            raise SystemExit(
-                "--serve-disagg cannot combine --prefix-cache with "
-                "--kv-quant: int8 blocks carry per-slot frozen scales "
-                "and cannot be shared across the worker pair"
-            )
     if cfg.default_deadline is not None and cfg.default_deadline <= 0:
         raise SystemExit("--default-deadline must be > 0 seconds")
     if cfg.speculate and cfg.temperature != 0.0:
@@ -537,9 +531,22 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     if cfg.prefix_cache and (cfg.prefix_block < 1
                              or cfg.prefix_block & (cfg.prefix_block - 1)):
         raise SystemExit("--prefix-block must be a power of two >= 1")
-    if cfg.prefix_cache and cfg.prefix_pool_blocks is not None \
-            and cfg.prefix_pool_blocks < 1:
-        raise SystemExit("--prefix-pool-blocks must be >= 1")
+    if cfg.host_blocks < 0:
+        raise SystemExit("--host-blocks must be >= 0")
+    host_blocks = cfg.host_blocks if cfg.kv_tiering == "on" else 0
+    if host_blocks:
+        if cfg.kv_layout != "paged":
+            raise SystemExit(
+                "--host-blocks KV tiering requires --kv-layout paged "
+                "(the tier demotes pool blocks; the contiguous layout "
+                "has none)"
+            )
+        if not cfg.prefix_cache:
+            raise SystemExit(
+                "--host-blocks KV tiering requires --prefix-cache "
+                "(demotion is what radix eviction becomes; with no "
+                "radix tree nothing ever demotes)"
+            )
     if cfg.kv_block is not None and (cfg.kv_block < 1
                                      or cfg.kv_block & (cfg.kv_block - 1)):
         raise SystemExit("--kv-block must be a power of two >= 1")
@@ -584,34 +591,11 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     params = init_params(jax.random.PRNGKey(cfg.seed), tcfg)
     if cfg.slo_ttft <= 0 or cfg.slo_tbt <= 0:
         raise SystemExit("--slo-ttft and --slo-tbt must be > 0")
-    # Deprecation shim (ISSUE 6): --prefix-pool-blocks described the OLD
-    # memory split (slots * cache_len of slot cache PLUS a separate
-    # prefix pool). Under the paged layout there is one --kv-blocks
-    # budget; map the old flag onto it at the equal-total-bytes point so
-    # existing invocations keep their memory footprint.
+    # The paged layout has ONE device budget (--kv-blocks) and one host
+    # budget (--host-blocks); the PR-6-deprecated --prefix-pool-blocks
+    # alias is gone (ISSUE 13) — the engine API keeps the retention-cap
+    # kwarg for tests, but the CLI no longer exposes the old split.
     kv_blocks = cfg.kv_blocks
-    prefix_pool_blocks = cfg.prefix_pool_blocks
-    if cfg.prefix_pool_blocks is not None and cfg.kv_layout == "paged":
-        kv_block = cfg.kv_block or (
-            cfg.prefix_block if cfg.prefix_cache else 64
-        )
-        if kv_blocks is None:
-            kv_blocks = (
-                cfg.slots * (-(-cache_len // kv_block))
-                + cfg.prefix_pool_blocks
-            )
-            log.warning(
-                "--prefix-pool-blocks is deprecated under the paged KV "
-                "layout: its %d blocks were folded into the unified "
-                "--kv-blocks budget (now %d). Pass --kv-blocks directly.",
-                cfg.prefix_pool_blocks, kv_blocks,
-            )
-        else:
-            log.warning(
-                "--prefix-pool-blocks is deprecated and ignored when "
-                "--kv-blocks is given (the paged pool is ONE budget)"
-            )
-        prefix_pool_blocks = None  # no separate retention cap from the CLI
     drafter = cfg.drafter
     if cfg.speculate and cfg.drafter == "model":
         # A shrunk draft transformer (half the layers, same vocab) from
@@ -641,10 +625,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         slo_tbt=cfg.slo_tbt,
         prefix_cache=cfg.prefix_cache,
         prefix_block=cfg.prefix_block,
-        prefix_pool_blocks=prefix_pool_blocks,
         kv_layout=cfg.kv_layout,
         kv_block=cfg.kv_block,
         kv_blocks=kv_blocks,
+        host_blocks=host_blocks,
         speculate=cfg.speculate,
         draft_k=cfg.draft_k,
         drafter=drafter,
@@ -811,9 +795,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
            if cfg.speculate else {}),
         **({"prefix_cache": {
             "block": cfg.prefix_block,
-            **({"pool_blocks": prefix_pool_blocks}
-               if prefix_pool_blocks is not None else {}),
         }} if cfg.prefix_cache else {}),
+        **({"kv_tiering": {"host_blocks": host_blocks}}
+           if host_blocks else {}),
         # Outcome counts ride ServeReport.as_dict (the ISSUE 10 outcome
         # vocabulary threaded through the report).
         **report.as_dict(),

@@ -146,20 +146,29 @@ class PagedKVCache:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedQuantKVCache:
-    """int8 paged KV: int8 block pools + per-SLOT frozen scales.
+    """int8 paged KV: int8 block pools + per-BLOCK scale scalars.
 
-    Scales stay per slot (``(L, B, Hkv, 1, D)``), not per block — the
-    quantize-after-prefill contract freezes one scale set per request's
-    prefill, and every block a slot writes is quantized under that slot's
-    scales. int8 blocks therefore cannot be shared between slots (two
-    slots' scales differ), so the prefix cache keeps its exact-dtype
-    sidecar pool under int8 serving (see ``serving/prefix_cache.py``).
+    Scales ride the POOL (``(L, N, Hkv)`` — one float per layer, physical
+    block, and KV head), not the slot (ISSUE 13): a published block
+    carries everything needed to dequantize it, so int8 blocks share
+    through the radix tree exactly like exact blocks — roughly doubling
+    effective pool capacity at the same device bytes. The
+    quantize-after-prefill contract becomes per block: each prompt
+    block's scale is the absmax of ITS rows at final-chunk quantization
+    (:func:`quantize_paged_blocks`), and decode rows appended later
+    quantize under the slot's **anchor** scale — the scale of the block
+    holding the slot's last pre-write row — which every block the write
+    *enters* (first row) inherits. All rows of a block are therefore
+    quantized under the block's own current scale, whichever slot wrote
+    them, and dequantization (per-block scalar, commuting out of the
+    score matmul — the property that keeps the int8-MXU q8q kernel's
+    post-matmul rescale a scalar multiply) is always consistent.
     """
 
     k: jax.Array        # (L, N, Hkv, block, D) int8 pool
     v: jax.Array        # (L, N, Hkv, block, D) int8 pool
-    k_scale: jax.Array  # (L, B, Hkv, 1, D) float32 — per slot
-    v_scale: jax.Array  # (L, B, Hkv, 1, D) float32 — per slot
+    k_scale: jax.Array  # (L, N, Hkv) float32 — per POOL block
+    v_scale: jax.Array  # (L, N, Hkv) float32 — per POOL block
     table: jax.Array    # (B, NB) int32
     length: jax.Array   # (B,) int32
 
@@ -237,6 +246,154 @@ def _quantize_rows(rows: jax.Array, scale: jax.Array) -> jax.Array:
     return jnp.clip(
         jnp.round(rows.astype(jnp.float32) / scale), -127, 127
     ).astype(jnp.int8)
+
+
+def quantize_paged_blocks(
+    k: jax.Array, v: jax.Array, block: int, valid: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-BLOCK symmetric int8 quantization of a just-prefilled B=1 cache.
+
+    ``k``/``v`` are ``(L, 1, Hkv, T, D)`` exact rows, ``valid`` the token
+    count (rows at ``>= valid`` must already be zeroed by the caller —
+    they quantize to 0 under any scale, and a zero block takes the
+    contract's fallback scale of 1.0 exactly like
+    :func:`quantize_symmetric_int8`'s zero channels). ``T`` pads up to a
+    whole number of ``block``-token spans; the scale of span ``j`` is
+    ``absmax`` over that span's valid rows and ALL channels — one scalar
+    per ``(layer, block, head)``, the granularity that lets a scale ride
+    the pool next to its block and commute out of the score matmul
+    (:class:`PagedQuantKVCache`). Returns ``(k_q, v_q, k_scale,
+    v_scale)`` with int8 rows shaped like the (padded) inputs and scales
+    ``(L, nb, Hkv)``.
+    """
+    del valid  # rows past it are pre-zeroed; absmax ignores them
+    L, B, Hkv, T, D = k.shape
+    nb = -(-T // block)
+    pad = nb * block - T
+
+    def one(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        xf = x.astype(jnp.float32)[:, 0]  # (L, Hkv, T, D)
+        if pad:
+            xf = jnp.pad(xf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        xb = xf.reshape(L, Hkv, nb, block, D)
+        amax = jnp.max(jnp.abs(xb), axis=(3, 4))  # (L, Hkv, nb)
+        scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+        q = jnp.clip(
+            jnp.round(xb / scale[:, :, :, None, None]), -127, 127
+        ).astype(jnp.int8)
+        q = q.reshape(L, Hkv, nb * block, D)[:, :, :T]
+        return q[:, None], jnp.moveaxis(scale, 1, 2)  # (L,1,Hkv,T,D), (L,nb,Hkv)
+
+    (k_q, k_s) = one(k)
+    (v_q, v_s) = one(v)
+    return k_q, v_q, k_s, v_s
+
+
+def gather_kv_blocks(
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    ids: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, ...]:
+    """The demote gather (ISSUE 13): stack pool blocks ``ids`` for ONE
+    batched D2H fetch — ``(nb, L, Hkv, block, D)`` K and V rows, plus
+    ``(nb, L, Hkv)`` per-block scale scalars for an int8 pool. Padded
+    ``ids`` entries clip to block 0; the host pool ignores their rows
+    (the id bucket bounds compiles, exactly like the prefix gathers)."""
+    idx = jnp.clip(ids, 0, pool_k.shape[1] - 1)
+    out = [
+        jnp.moveaxis(pool_k[:, idx], 1, 0),
+        jnp.moveaxis(pool_v[:, idx], 1, 0),
+    ]
+    if k_scale is not None:
+        out.append(jnp.moveaxis(k_scale[:, idx], 1, 0))
+        out.append(jnp.moveaxis(v_scale[:, idx], 1, 0))
+    return tuple(out)
+
+
+def scatter_kv_blocks(
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    ids: jax.Array,
+    k_rows: jax.Array,
+    v_rows: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    ks_rows: Optional[jax.Array] = None,
+    vs_rows: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, ...]:
+    """The restore scatter (ISSUE 13): land one H2D batch of host-tier
+    blocks into freshly allocated pool rows ``ids`` (padded entries
+    point past the pool and DROP). ``k_rows``/``v_rows`` are the
+    ``(nb, L, Hkv, block, D)`` staged host bytes; int8 pools also take
+    their per-block scale scalars. Donated by the engine: one dispatch
+    restores a whole matched path. Returns the updated pool arrays
+    (+ scale arrays when quantized)."""
+    out = [
+        pool_k.at[:, ids].set(jnp.moveaxis(k_rows, 0, 1), mode="drop"),
+        pool_v.at[:, ids].set(jnp.moveaxis(v_rows, 0, 1), mode="drop"),
+    ]
+    if k_scale is not None:
+        out.append(
+            k_scale.at[:, ids].set(jnp.moveaxis(ks_rows, 0, 1),
+                                   mode="drop")
+        )
+        out.append(
+            v_scale.at[:, ids].set(jnp.moveaxis(vs_rows, 0, 1),
+                                   mode="drop")
+        )
+    return tuple(out)
+
+
+def insert_dequant_prefix(
+    staging: KVCache,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    ids: jax.Array,
+    matched: jax.Array,
+) -> KVCache:
+    """Dequantize matched int8 pool blocks into the B=1 staging cache.
+
+    The int8 paged hit path (ISSUE 13): the slot references the matched
+    int8 blocks IN PLACE through its table, but the suffix's exact
+    staged prefill needs the prefix as activations-grade rows — this
+    places ``matched`` dequantized tokens (``int8 · per-block scale``)
+    at positions ``[0, matched)`` of staging slot 0 and sets its length,
+    mirroring :func:`insert_prefix_blocks`. Re-quantizing these rows at
+    final chunk reproduces the original int8 bytes exactly (absmax/127
+    scaling round-trips int8 code points), so shared blocks never need
+    rewriting.
+    """
+    nb = ids.shape[0]
+    block = pool_k.shape[3]
+    span = nb * block
+    matched = jnp.asarray(matched, jnp.int32)
+    idx = jnp.clip(ids, 0, pool_k.shape[1] - 1)
+
+    def place(buf: jax.Array, pool: jax.Array, scale: jax.Array):
+        rows = pool[:, idx]                       # (L, nb, Hkv, blk, D)
+        s = scale[:, idx]                         # (L, nb, Hkv)
+        rows = rows.astype(jnp.float32) * s[:, :, :, None, None]
+        rows = jnp.moveaxis(rows, 1, 2)           # (L, Hkv, nb, blk, D)
+        L, Hkv = rows.shape[0], rows.shape[1]
+        rows = rows.reshape(L, Hkv, span, rows.shape[-1])
+        cur = buf[:, 0]                           # (L, Hkv, cap, D)
+        window = lax.dynamic_slice_in_dim(cur, 0, span, axis=2)
+        valid = (
+            jnp.arange(span, dtype=jnp.int32) < matched
+        )[None, None, :, None]
+        merged = jnp.where(valid, rows.astype(buf.dtype), window)
+        cur = lax.dynamic_update_slice_in_dim(cur, merged, 0, axis=2)
+        return cur[:, None]
+
+    return KVCache(
+        k=place(staging.k, pool_k, k_scale),
+        v=place(staging.v, pool_v, v_scale),
+        length=jnp.full_like(staging.length, matched),
+    )
 
 
 def init_cache(
@@ -322,11 +479,14 @@ def init_paged_cache(
         _CACHE_CAPACITY.set(nb * block)
         _CACHE_ALLOCS.labels(sharded=str(mesh is not None).lower()).inc()
     if quantize:
-        sshape = (cfg.n_layers, batch_size, cfg.n_kv_heads, 1, cfg.d_head)
+        sshape = (cfg.n_layers, blocks, cfg.n_kv_heads)
         return PagedQuantKVCache(
             k=k, v=v,
-            # Two distinct buffers: the engine's donating steps may not
-            # alias k_scale and v_scale.
+            # Per-BLOCK scale scalars (see the class docstring). Two
+            # distinct buffers: the engine's donating steps may not
+            # alias k_scale and v_scale. Unit scales = the empty-cache
+            # fallback, same as quantize_symmetric_int8's zero-channel
+            # contract.
             k_scale=jnp.ones(sshape, jnp.float32),
             v_scale=jnp.ones(sshape, jnp.float32),
             table=table, length=length,
@@ -381,25 +541,34 @@ def paged_insert_slot(
     plen: jax.Array,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    lo: Union[int, jax.Array] = 0,
 ) -> Union[PagedKVCache, PagedQuantKVCache]:
     """Place a B=1 prefilled cache's rows into one slot's mapped blocks.
 
     The paged mirror of the engine's contiguous insert: ``k_rows`` /
     ``v_rows`` are ``(L, 1, Hkv, T, D)`` (a mini/staging cache, possibly
-    already int8), token positions ``[0, plen)`` scatter through the
-    slot's table row (``plen`` may be traced; rows past it drop), the
-    slot's ``length`` becomes ``plen``, and — for a quantized cache —
-    the slot's frozen scales are installed. The caller must have mapped
-    blocks covering ``[0, plen)`` in the table first.
+    already int8), token positions ``[lo, plen)`` scatter through the
+    slot's table row (``plen``/``lo`` may be traced; rows outside drop),
+    the slot's ``length`` becomes ``plen``, and — for a quantized cache —
+    the prompt blocks' per-BLOCK scales (``(L, nb, Hkv)``, from
+    :func:`quantize_paged_blocks`) land in the pool's scale arrays
+    through the same table row. ``lo`` exists for the int8 prefix-hit
+    path: the matched prefix's blocks are SHARED (tree-owned, already
+    carrying their own scales) and must not be rewritten — ``lo`` is the
+    block-aligned matched length, so only the slot's own suffix blocks
+    take writes. The caller must have mapped blocks covering
+    ``[0, plen)`` in the table first.
     """
     L, _, Hkv, T, D = k_rows.shape
     N, block = cache.blocks, cache.block
     row = lax.dynamic_index_in_dim(cache.table, slot, axis=0, keepdims=False)
     pos = jnp.arange(T, dtype=jnp.int32)
     lb = jnp.clip(pos // block, 0, row.shape[0] - 1)
-    # Rows past plen AND past the slot's logical capacity both drop
-    # (same over-capacity safety as _paged_pool_write).
-    ok = (pos < plen) & (pos < row.shape[0] * block)
+    lo = jnp.asarray(lo, jnp.int32)
+    # Rows below lo (shared prefix blocks), past plen, AND past the
+    # slot's logical capacity all drop (same over-capacity safety as
+    # _paged_pool_write).
+    ok = (pos >= lo) & (pos < plen) & (pos < row.shape[0] * block)
     pb = jnp.where(ok, jnp.take(row, lb), N)  # OOB -> dropped
     off = pos % block
 
@@ -413,9 +582,18 @@ def paged_insert_slot(
         cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
     )
     if isinstance(cache, PagedQuantKVCache):
-        put_s = lambda buf, new: lax.dynamic_update_index_in_dim(
-            buf, new[:, 0], slot, axis=1
+        nbk = k_scale.shape[1]
+        blocks_idx = jnp.arange(nbk, dtype=jnp.int32)
+        blk_ok = (
+            (blocks_idx >= lo // block)
+            & (blocks_idx * block < plen)
+            & (blocks_idx < row.shape[0])
         )
+        pb_s = jnp.where(
+            blk_ok, jnp.take(row, jnp.clip(blocks_idx, 0,
+                                           row.shape[0] - 1)), N
+        )
+        put_s = lambda buf, new: buf.at[:, pb_s, :].set(new, mode="drop")
         return PagedQuantKVCache(
             k=put(cache.k, k_rows), v=put(cache.v, v_rows),
             k_scale=put_s(cache.k_scale, k_scale),
@@ -589,10 +767,14 @@ def forward_step(
     # the per-layer gather — identical rows in identical order. On TPU the
     # paged kernels stream blocks in place and this path never runs.
     hoist_view = False
+    paged_quant = paged and quant
     if paged:
         from tree_attention_tpu.ops import _on_tpu, _pallas_available
         from tree_attention_tpu.ops.decode import _AUTO_PALLAS
 
+        on_kernels = (
+            _AUTO_PALLAS and _on_tpu(params["embed"]) and _pallas_available()
+        )
         # Under a >1-way seq mesh the contiguous view would re-route
         # decode_attention onto the tree-merge branch (the view is
         # replicated, not seq-sharded) — keep the block-table path there.
@@ -600,18 +782,72 @@ def forward_step(
             max(mesh.shape.get(axes["seq"] or "", 1), 1)
             if mesh is not None else 1
         )
-        hoist_view = seq_shards == 1 and not (
-            _AUTO_PALLAS and _on_tpu(params["embed"]) and _pallas_available()
-        )
+        if paged_quant:
+            # Per-block scales (ISSUE 13): on TPU the q8 kernels read
+            # them as a block-indexed lane-broadcast operand; everywhere
+            # else the whole step runs on a DEQUANTIZED logical view
+            # (int8 · per-block scale, built once per step) through the
+            # exact attention paths — mesh included, since the view is
+            # replicated and the tree merge handles it like a contiguous
+            # cache. The pool stays int8 + scales; only attention's
+            # operand is dequantized, so CPU and TPU agree to int8
+            # quantization-step resolution and the engine's token-parity
+            # contracts see one consistent numeric story per topology.
+            hoist_view = not on_kernels
+        else:
+            hoist_view = seq_shards == 1 and not on_kernels
     if hoist_view:
         idx = jnp.clip(cache.table, 0, cache.blocks - 1)  # (B, NB)
 
-        def _view(pool: jax.Array) -> jax.Array:
+        def _view(pool: jax.Array,
+                  scales: Optional[jax.Array] = None) -> jax.Array:
             rows = jnp.moveaxis(pool[:, idx], 2, 3)  # (L, B, Hkv, NB, blk, D)
+            if scales is not None:
+                s = jnp.swapaxes(scales[:, idx], 2, 3)  # (L, B, Hkv, NB)
+                rows = (
+                    rows.astype(jnp.float32) * s[..., None, None]
+                ).astype(cfg.dtype)
             L, Bv, Hkv, NB, blk, D = rows.shape
             return rows.reshape(L, Bv, Hkv, NB * blk, D)
 
-        k_view0, v_view0 = _view(cache.k), _view(cache.v)
+        if paged_quant:
+            k_view0 = _view(cache.k, cache.k_scale)
+            v_view0 = _view(cache.v, cache.v_scale)
+        else:
+            k_view0, v_view0 = _view(cache.k), _view(cache.v)
+    if paged_quant:
+        # The anchor rule (see PagedQuantKVCache): every row this step
+        # writes for slot i quantizes under the scale of the block
+        # holding the slot's last pre-write row, and each block the
+        # write ENTERS (its first row) inherits that scale — so a
+        # block's rows and its pool scale always agree, across decode
+        # appends, speculative rollback re-writes, and remapped blocks.
+        blk_sz = cache.block
+        NBt = cache.table.shape[1]
+        anchor_pb = jnp.clip(
+            jnp.take_along_axis(
+                cache.table,
+                jnp.clip((start - 1) // blk_sz, 0, NBt - 1)[:, None],
+                axis=1,
+            )[:, 0],
+            0, cache.blocks - 1,
+        )  # (B,) physical anchor block per slot
+        n_valid_all = (
+            jnp.full((B,), Tq, jnp.int32) if n_tokens is None else n_tokens
+        )
+        pos_all = start[:, None] + jnp.arange(Tq, dtype=jnp.int32)[None, :]
+        write_pb = jnp.take_along_axis(
+            cache.table, jnp.clip(pos_all // blk_sz, 0, NBt - 1), axis=1
+        )  # (B, Tq)
+        entered = (
+            (jnp.arange(Tq, dtype=jnp.int32)[None, :]
+             < n_valid_all[:, None])
+            & (pos_all % blk_sz == 0)
+            & (pos_all < NBt * blk_sz)
+        )
+        scale_tgt = jnp.where(
+            entered, write_pb, cache.blocks
+        ).reshape(-1)  # invalid rows scatter OOB and drop
 
     def body(x, layer_and_cache):
         parts = list(layer_and_cache)
@@ -633,8 +869,34 @@ def forward_step(
         # Write slot i's new rows at its own [start[i], start[i]+Tq): a
         # vmapped dynamic-update over batch (per-slot token offsets). Under
         # a mesh GSPMD turns it into per-shard masked writes on the seq dim.
-        # Quantized caches quantize the rows under the frozen scales first.
-        if quant:
+        # Quantized caches quantize the rows first — under the per-slot
+        # frozen scales (contiguous) or the per-block anchor scale
+        # (paged; entered blocks inherit it, see above).
+        k_deq = v_deq = None
+        if quant and paged:
+            k_anchor = k_s[anchor_pb][:, :, None, None]  # (B, Hkv, 1, 1)
+            v_anchor = v_s[anchor_pb][:, :, None, None]
+            k_new = _quantize_rows(k_new, k_anchor)
+            v_new = _quantize_rows(v_new, v_anchor)
+            vals_k = jnp.broadcast_to(
+                k_anchor[:, None, :, 0, 0], (B, Tq, k_s.shape[1])
+            ).reshape(-1, k_s.shape[1])
+            vals_v = jnp.broadcast_to(
+                v_anchor[:, None, :, 0, 0], (B, Tq, v_s.shape[1])
+            ).reshape(-1, v_s.shape[1])
+            k_s = k_s.at[scale_tgt].set(vals_k, mode="drop")
+            v_s = v_s.at[scale_tgt].set(vals_v, mode="drop")
+            if hoist_view:
+                # The view holds DEQUANTIZED rows: mirror exactly what
+                # the pool now holds (quantize-then-dequantize), so
+                # attention over the view == attention over the pool.
+                k_deq = (
+                    k_new.astype(jnp.float32) * k_anchor
+                ).astype(k_view.dtype)
+                v_deq = (
+                    v_new.astype(jnp.float32) * v_anchor
+                ).astype(v_view.dtype)
+        elif quant:
             k_new = _quantize_rows(k_new, k_s)
             v_new = _quantize_rows(v_new, v_s)
         if paged:
@@ -657,11 +919,13 @@ def forward_step(
                 # pre-scan gather predates this layer's write) — a cheap
                 # Tq-row window write, vs re-gathering the whole pool.
                 wv = jax.vmap(_masked_window_write, in_axes=(0, 0, 0, 0))
+                mk = k_new if k_deq is None else k_deq
+                mv = v_new if v_deq is None else v_deq
                 k_view = wv(
-                    k_view, k_new.astype(k_view.dtype), start, n_valid
+                    k_view, mk.astype(k_view.dtype), start, n_valid
                 )
                 v_view = wv(
-                    v_view, v_new.astype(v_view.dtype), start, n_valid
+                    v_view, mv.astype(v_view.dtype), start, n_valid
                 )
         elif n_tokens is None:
             write = jax.vmap(
@@ -700,33 +964,39 @@ def forward_step(
         if paged and not hoist_view:
             attn_kw["block_table"] = cache.table
         ak, av = (k_view, v_view) if hoist_view else (k_cache, v_cache)
-        if quant:
+        if quant and not (paged and hoist_view):
             out, _ = decode_attention(
                 q, ak, av, k_scale=k_s, v_scale=v_s,
                 quant_kernel=quant_kernel, **attn_kw,
             )
         else:
+            # Exact caches — and the paged-quant DEQUANTIZED view (the
+            # off-kernel path; see the hoist_view comment above).
             out, _ = decode_attention(
                 q, ak, av,
                 impl=cfg.attn_impl, num_splits=num_splits, **attn_kw,
             )
         x = x + _unheads(out) @ layer["wo"]
         x = x + _mlp_block(layer, rms_norm(x, layer["ln2"], cfg.norm_eps))
-        return x, (k_cache, v_cache)
+        ys = (k_cache, v_cache)
+        if paged and quant:
+            ys = ys + (k_s, v_s)  # entered blocks' inherited scales
+        return x, ys
 
     xs = (params["layers"], cache.k, cache.v)
     if hoist_view:
         xs = xs + (k_view0, v_view0)
     if quant:
         xs = xs + (cache.k_scale, cache.v_scale)
-    x, (new_k, new_v) = lax.scan(body, x, xs)
+    x, scanned = lax.scan(body, x, xs)
+    new_k, new_v = scanned[0], scanned[1]
     x = rms_norm(x, params["ln_f"], cfg.norm_eps)
     logits = (x @ params["wout"]).astype(jnp.float32)
     grew = Tq if n_tokens is None else n_tokens
     if paged and quant:
         new_cache: Union[KVCache, QuantKVCache, PagedKVCache,
                          PagedQuantKVCache] = PagedQuantKVCache(
-            k=new_k, v=new_v, k_scale=cache.k_scale, v_scale=cache.v_scale,
+            k=new_k, v=new_v, k_scale=scanned[2], v_scale=scanned[3],
             table=cache.table, length=start + grew,
         )
     elif paged:

@@ -114,7 +114,8 @@ def _decode_visibility_mask(s, qi, si, *, bq, bk, tq, tk,
     return jnp.where(valid, s, NEG_INF)
 
 
-def _decode_softmax_fold(s, v_tile, m_scr, l_scr, acc_scr, *, si, bk, tk):
+def _decode_softmax_fold(s, v_tile, m_scr, l_scr, acc_scr, *, si, bk, tk,
+                         v_scale=None):
     """Fold one masked score tile and its V tile into the running
     online-softmax state — shared by both decode kernels.
 
@@ -124,6 +125,13 @@ def _decode_softmax_fold(s, v_tile, m_scr, l_scr, acc_scr, *, si, bk, tk):
     unpadded; interpret mode NaN-poisons it) — p's masked columns are
     exactly 0, but 0·NaN = NaN, so those rows must be zeroed. Static no-op
     for divisible shapes.
+
+    ``v_scale`` (a scalar — the per-BLOCK V dequantization scale of a
+    paged int8 tile, ISSUE 13) multiplies ``p`` AFTER the running sum
+    ``l`` is taken: the softmax normalizer is over the (dequantized)
+    scores only, the scale belongs to the V values — ``p·(v_q·s) ==
+    (p·s)·v_q``, one scalar multiply on the probability tile instead of
+    a per-element dequant of the V stream.
     """
     m_prev = m_scr[:, :1]  # (bq, 1)
     l_prev = l_scr[:, :1]
@@ -133,6 +141,8 @@ def _decode_softmax_fold(s, v_tile, m_scr, l_scr, acc_scr, *, si, bk, tk):
     alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
     p = jnp.exp(s - m_safe)  # (bq, bk); masked cols are exactly 0
     l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if v_scale is not None:
+        p = p * v_scale
     if v_tile.dtype == jnp.int8:
         v_tile = v_tile.astype(jnp.bfloat16)
     if tk % bk:
@@ -350,6 +360,7 @@ def _flash_decode_paged_kernel(
     block_k: int,
     n_kv_heads: int,
     tree: bool = False,
+    block_scales: bool = False,
 ):
     """Block-table variant of :func:`_flash_decode_kernel`: the split-KV
     grid dimension walks each slot's LOGICAL blocks and the BlockSpec
@@ -360,11 +371,26 @@ def _flash_decode_paged_kernel(
     mask against each slot's own ``q_offset`` hides every unwritten (or
     garbage-mapped) position, and the per-slot liveness cull skips whole
     blocks past the slot's length — a short slot reads only its own few
-    blocks of the pool."""
+    blocks of the pool.
+
+    ``block_scales`` (ISSUE 13, the shareable-int8 pool): two extra
+    lane-broadcast operands carry each logical block's K and V
+    dequantization SCALARS — K's multiplies the score tile after the
+    matmul (a scalar commutes out of the dot product, so no per-element
+    K dequant rides the KV stream), V's folds into ``p`` (see
+    :func:`_decode_softmax_fold`)."""
     del tbl_ref  # consumed by the index maps
-    if tree:
+    ks_ref = vs_ref = None
+    if tree and block_scales:
+        q_ref, tb_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    elif tree:
         q_ref, tb_ref, k_ref, v_ref, out_ref, lse_ref, \
             m_scr, l_scr, acc_scr = refs
+    elif block_scales:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+        tb_ref = None
     else:
         q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
         tb_ref = None
@@ -401,6 +427,8 @@ def _flash_decode_paged_kernel(
             preferred_element_type=jnp.float32,
             precision=matmul_precision(q_ref.dtype, k_tile.dtype),
         ) * scale
+        if ks_ref is not None:
+            s = s * ks_ref[0, 0, 0]  # this block's K dequant scalar
 
         s = _decode_visibility_mask(
             s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
@@ -408,7 +436,8 @@ def _flash_decode_paged_kernel(
             tree_bits=None if tb_ref is None else tb_ref[0][:, :1],
         )
         _decode_softmax_fold(
-            s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
+            s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk,
+            v_scale=None if vs_ref is None else vs_ref[0, 0, 0],
         )
 
     @pl.when(si == n_s - 1)
@@ -433,14 +462,27 @@ def _flash_decode_paged_q8q_kernel(
     block_k: int,
     n_kv_heads: int,
     tree: bool = False,
+    block_scales: bool = False,
 ):
     """Block-table variant of :func:`_flash_decode_q8q_kernel` — same
     int8-MXU score path, KV streamed through the scalar-prefetched
-    table (see :func:`_flash_decode_paged_kernel`)."""
+    table (see :func:`_flash_decode_paged_kernel`). With
+    ``block_scales`` (ISSUE 13) the per-BLOCK K/V dequant scalars ride
+    two extra lane-broadcast operands: K's joins the per-row Q scale in
+    the post-matmul rescale (both are scalars w.r.t. the int8 dot, so
+    the MXU path stays int8 × int8 → int32), V's folds into ``p``."""
     del tbl_ref
-    if tree:
+    ks_ref = vs_ref = None
+    if tree and block_scales:
+        q_ref, qs_ref, tb_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, \
+            lse_ref, m_scr, l_scr, acc_scr = refs
+    elif tree:
         q_ref, qs_ref, tb_ref, k_ref, v_ref, out_ref, lse_ref, \
             m_scr, l_scr, acc_scr = refs
+    elif block_scales:
+        q_ref, qs_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+        tb_ref = None
     else:
         q_ref, qs_ref, k_ref, v_ref, out_ref, lse_ref, \
             m_scr, l_scr, acc_scr = refs
@@ -475,6 +517,8 @@ def _flash_decode_paged_q8q_kernel(
             preferred_element_type=jnp.int32,
         )
         s = s_i.astype(jnp.float32) * qs_ref[0][:, :1]
+        if ks_ref is not None:
+            s = s * ks_ref[0, 0, 0]  # this block's K dequant scalar
 
         s = _decode_visibility_mask(
             s, qi, si, bq=bq, bk=bk, tq=tq, tk=tk,
@@ -482,7 +526,8 @@ def _flash_decode_paged_q8q_kernel(
             tree_bits=None if tb_ref is None else tb_ref[0][:, :1],
         )
         _decode_softmax_fold(
-            s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk
+            s, v_ref[0, 0], m_scr, l_scr, acc_scr, si=si, bk=bk, tk=tk,
+            v_scale=None if vs_ref is None else vs_ref[0, 0, 0],
         )
 
     @pl.when(si == n_s - 1)
@@ -506,6 +551,28 @@ def _paged_kv_map(n_kv_heads: int):
         return (tbl_ref[bh // n_kv_heads, si], bh % n_kv_heads, 0, 0)
 
     return index_map
+
+
+def _paged_scale_map(bh, qi, si, offs_ref, tbl_ref):
+    """Per-block scale operand map (ISSUE 13): the scales were pre-
+    gathered per LOGICAL block (see :func:`_block_scale_rows`), so grid
+    step ``si`` just reads row ``si`` — no second table dereference."""
+    del qi, offs_ref, tbl_ref
+    return (bh, si, 0)
+
+
+def _block_scale_rows(scale: jax.Array, block_table: jax.Array) -> jax.Array:
+    """Arrange ``(N, Hkv)`` per-block scale scalars into the
+    ``(B·Hkv, NB, LANES)`` lane-broadcast operand the paged kernels read
+    — one scalar per (slot, head, logical block), gathered through the
+    table once per call (O(B·NB·Hkv) floats, noise next to the KV bytes
+    the grid streams). The same VMEM idiom as the q8q per-row Q scales
+    and the tree bitmasks."""
+    N, Hkv = scale.shape
+    B, NB = block_table.shape
+    g = scale[jnp.clip(block_table, 0, N - 1)]      # (B, NB, Hkv)
+    g = jnp.moveaxis(g, 2, 1).reshape(B * Hkv, NB)
+    return jnp.broadcast_to(g[:, :, None], (B * Hkv, NB, _LANES))
 
 
 def _paged_decode_call(
@@ -671,16 +738,76 @@ def attention_pallas_decode_q8(
         raise ValueError(
             f"k_q/v_q must be int8, got {k_q.dtype}/{v_q.dtype}"
         )
-    if k_scale.shape != (B, Hkv, 1, D) or v_scale.shape != (B, Hkv, 1, D):
-        raise ValueError(
-            f"scales must be (B, Hkv, 1, D) = {(B, Hkv, 1, D)}, got "
-            f"{k_scale.shape}/{v_scale.shape}"
-        )
     if Hq % Hkv:
         raise ValueError(
             f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
         )
     G = Hq // Hkv
+    if block_table is not None and getattr(k_scale, "ndim", 4) == 2:
+        # Per-BLOCK scale scalars (ISSUE 13): the fold-into-Q trick below
+        # cannot express a scale that varies along the KV stream, so this
+        # shape takes its own paged call — Q rides bf16 un-folded (softmax
+        # scale applied by the kernel), each block's K scalar rescales the
+        # score tile post-matmul, V's folds into p.
+        N = k_q.shape[0]
+        if k_scale.shape != (N, Hkv) or v_scale.shape != (N, Hkv):
+            raise ValueError(
+                f"per-block scales must be (N, Hkv) = {(N, Hkv)}, got "
+                f"{k_scale.shape}/{v_scale.shape}"
+            )
+        if tree_mask is not None:
+            if not causal:
+                raise ValueError("tree_mask requires causal=True")
+            if Tq > 32:
+                raise ValueError(
+                    f"tree_mask packs into int32 bitmasks: Tq={Tq} "
+                    f"exceeds 32"
+                )
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        out_dtype = q.dtype
+        sm = (D ** -0.5) if scale is None else scale
+        r = G * Tq
+        bq = min(-(-r // 8) * 8, 128)
+        qp = _pad_dim(
+            q.astype(jnp.bfloat16).reshape(B, Hkv, r, D), 2, bq
+        ).reshape(B * Hkv, -1, D)
+        n_q = qp.shape[1] // bq
+        blk = k_q.shape[2]
+        if obs.REGISTRY.enabled:
+            _KERNEL_BUILDS.labels(kernel="paged_q8_block").inc()
+        tensors = [qp, k_q, v_q,
+                   _block_scale_rows(k_scale, block_table),
+                   _block_scale_rows(v_scale, block_table)]
+        in_specs = [
+            pl.BlockSpec((1, bq, D), _paged_q_map),
+            pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
+            pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
+            pl.BlockSpec((1, 1, _LANES), _paged_scale_map),
+            pl.BlockSpec((1, 1, _LANES), _paged_scale_map),
+        ]
+        if tree_mask is not None:
+            tensors.insert(1, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
+            in_specs.insert(1, pl.BlockSpec((1, bq, _LANES), _paged_q_map))
+        out, lse = _paged_decode_call(
+            _flash_decode_paged_kernel,
+            dict(scale=sm, causal=causal, tq=Tq, block_q=bq, block_k=blk,
+                 n_kv_heads=Hkv, tree=tree_mask is not None,
+                 block_scales=True),
+            tensors,
+            in_specs,
+            q_offset=q_offset, kv_offset=kv_offset,
+            block_table=block_table, batch=B, n_q=n_q, bq=bq, d=D,
+            out_dtype=jnp.bfloat16, interpret=interpret,
+        )
+        out = out[:, :r].reshape(B, Hq, Tq, D).astype(out_dtype)
+        lse = lse[:, :r, 0].reshape(B, Hq, Tq)
+        return out, lse
+    if k_scale.shape != (B, Hkv, 1, D) or v_scale.shape != (B, Hkv, 1, D):
+        raise ValueError(
+            f"scales must be (B, Hkv, 1, D) = {(B, Hkv, 1, D)}, got "
+            f"{k_scale.shape}/{v_scale.shape}"
+        )
     # block_size=None falls through to the base kernel, which resolves it
     # from the q8 tile table when K/V are int8 (the one home of that
     # default).
@@ -752,7 +879,21 @@ def attention_pallas_decode_q8q(
         raise ValueError(
             f"k_q/v_q must be int8, got {k_q.dtype}/{v_q.dtype}"
         )
-    if k_scale.shape != (B, Hkv, 1, D) or v_scale.shape != (B, Hkv, 1, D):
+    # Per-BLOCK scale scalars (ISSUE 13): (N, Hkv) — one dequant scalar
+    # per pool block per head, riding block-indexed lane-broadcast
+    # operands into the kernel. Only meaningful with a block table; the
+    # contiguous shape keeps the per-slot (B, Hkv, 1, D) channel scales
+    # (which fold into Q — a per-block scale cannot, it varies along
+    # the KV stream).
+    per_block = block_table is not None and getattr(k_scale, "ndim", 4) == 2
+    if per_block:
+        N = k_q.shape[0]
+        if k_scale.shape != (N, Hkv) or v_scale.shape != (N, Hkv):
+            raise ValueError(
+                f"per-block scales must be (N, Hkv) = {(N, Hkv)}, got "
+                f"{k_scale.shape}/{v_scale.shape}"
+            )
+    elif k_scale.shape != (B, Hkv, 1, D) or v_scale.shape != (B, Hkv, 1, D):
         raise ValueError(
             f"scales must be (B, Hkv, 1, D) = {(B, Hkv, 1, D)}, got "
             f"{k_scale.shape}/{v_scale.shape}"
@@ -785,12 +926,16 @@ def attention_pallas_decode_q8q(
             jnp.full((B, Hq, Tq), NEG_INF, jnp.float32),
         )
 
-    # Fold both scales into Q in f32, then per-row absmax int8 quantize
+    # Fold the scales into Q in f32, then per-row absmax int8 quantize
     # (the one q8 numeric contract, quantize_symmetric_int8, reduced over
     # the head-dim axis) — the row scale rides a separate (bq, LANES)
-    # input into the kernel.
+    # input into the kernel. Per-block K scales cannot fold (they vary
+    # along the KV stream): only the softmax scale folds, and the
+    # kernel's post-matmul rescale picks up each block's scalar.
     r = G * Tq
-    qf = q.astype(jnp.float32).reshape(B, Hkv, r, D) * (k_scale * sm)
+    qf = q.astype(jnp.float32).reshape(B, Hkv, r, D) * (
+        sm if per_block else (k_scale * sm)
+    )
     q_i, qs = quantize_symmetric_int8(qf, axis=3)
 
     bq = min(-(-r // 8) * 8, 128)
@@ -815,6 +960,15 @@ def attention_pallas_decode_q8q(
             pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
             pl.BlockSpec((1, 1, blk, D), _paged_kv_map(Hkv)),
         ]
+        if per_block:
+            tensors += [
+                _block_scale_rows(k_scale, block_table),
+                _block_scale_rows(v_scale, block_table),
+            ]
+            in_specs += [
+                pl.BlockSpec((1, 1, _LANES), _paged_scale_map),
+                pl.BlockSpec((1, 1, _LANES), _paged_scale_map),
+            ]
         if tree_mask is not None:
             tensors.insert(2, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
             in_specs.insert(
@@ -823,7 +977,8 @@ def attention_pallas_decode_q8q(
         out, lse = _paged_decode_call(
             _flash_decode_paged_q8q_kernel,
             dict(causal=causal, tq=Tq, block_q=bq, block_k=blk,
-                 n_kv_heads=Hkv, tree=tree_mask is not None),
+                 n_kv_heads=Hkv, tree=tree_mask is not None,
+                 block_scales=per_block),
             tensors,
             in_specs,
             q_offset=q_offset, kv_offset=kv_offset,
@@ -831,9 +986,14 @@ def attention_pallas_decode_q8q(
             out_dtype=jnp.bfloat16, interpret=interpret,
         )
         out = out[:, :r]
-        out = (
-            out.astype(jnp.float32).reshape(B, Hkv, r, D) * v_scale
-        ).reshape(B, Hq, Tq, D).astype(out_dtype)
+        if per_block:
+            # V dequant already happened in-kernel (per-block scalars
+            # fold into p); no per-channel epilogue remains.
+            out = out.reshape(B, Hq, Tq, D).astype(out_dtype)
+        else:
+            out = (
+                out.astype(jnp.float32).reshape(B, Hkv, r, D) * v_scale
+            ).reshape(B, Hq, Tq, D).astype(out_dtype)
         lse = lse[:, :r, 0].reshape(B, Hq, Tq)
         return out, lse
 

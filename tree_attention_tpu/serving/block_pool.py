@@ -51,8 +51,12 @@ _BLOCKS_FREE = obs.gauge(
     "unified KV pool blocks on the free list",
 )
 
-# Block ownership states (the debug ledger's vocabulary).
-_FREE, _PRIVATE, _CACHED = 0, 1, 2
+# Block ownership states (the debug ledger's vocabulary). A _DEMOTED
+# block is owned by the host tier's staging queue: the radix tree evicted
+# it toward host RAM (ISSUE 13), the D2H copy has not run yet, and the
+# block must not be reused until the flush lands it on the host and calls
+# :meth:`BlockAllocator.free_demoted`.
+_FREE, _PRIVATE, _CACHED, _DEMOTED = 0, 1, 2, 3
 
 
 class BlockAllocator:
@@ -85,6 +89,15 @@ class BlockAllocator:
         self.transferred = 0
         self._evict_one: Optional[Callable[[], bool]] = None
         self._evictable: Optional[Callable[[], int]] = None
+        # Demotion staging (ISSUE 13): with a host tier under the pool,
+        # eviction DEMOTES blocks (state _DEMOTED) instead of freeing
+        # them, and the flusher runs the batched D2H gather that finally
+        # frees them. ``demote_batch`` is how many leaves one dry alloc
+        # demotes before flushing — the batch that makes "one jitted
+        # gather per demotion batch" a real amortisation instead of a
+        # per-block sync.
+        self._flush_demotions: Optional[Callable[[], int]] = None
+        self.demote_batch = 8
 
     # -- introspection ----------------------------------------------------
 
@@ -120,6 +133,14 @@ class BlockAllocator:
         self._evict_one = evict_one
         self._evictable = evictable
 
+    def set_demote_flusher(self, flush: Callable[[], int]) -> None:
+        """``flush()`` must complete every pending demotion's D2H copy
+        and :meth:`free_demoted` the device blocks, returning how many it
+        freed. The engine registers this when KV tiering is on; alloc()
+        calls it only when a backed reservation finds the free list dry
+        (the common flush point is the engine's end-of-tick staging)."""
+        self._flush_demotions = flush
+
     # -- reservations -----------------------------------------------------
 
     def reserve(self, n: int) -> bool:
@@ -146,11 +167,22 @@ class BlockAllocator:
         and pins (which shrink evictability) are themselves reserved."""
         assert self.reserved > 0, "alloc without a backing reservation"
         self.reserved -= 1
-        if not self._free:
-            # Load-bearing call — NOT inside the assert (python -O strips
-            # assert statements, and the eviction must still run).
-            evicted = (self._evict_one is not None and self._evict_one())
-            if not evicted:
+        while not self._free:
+            # Load-bearing calls — NOT inside an assert (python -O strips
+            # assert statements, and the eviction must still run). With a
+            # host tier, evict_one() DEMOTES (the block parks in state
+            # _DEMOTED, not on the free list), so a dry alloc demotes a
+            # small batch of leaves and flushes the staged D2H once —
+            # one jitted gather per batch, not one sync per block.
+            n = 0
+            while not self._free and n < self.demote_batch:
+                if self._evict_one is None or not self._evict_one():
+                    break
+                n += 1
+            if not self._free and self._flush_demotions is not None \
+                    and self._flush_demotions() > 0:
+                continue
+            if not self._free:
                 raise AssertionError(
                     "allocator invariant broken: a backed reservation "
                     "found neither a free block nor an evictable leaf"
@@ -225,6 +257,40 @@ class BlockAllocator:
         """The radix tree evicts a refcount-0 leaf's block."""
         assert self._state[bid] == _CACHED, (
             f"block {bid} evicted while not tree-owned"
+        )
+        self._state[bid] = _FREE
+        self._free.append(bid)
+        self.gen += 1
+
+    # -- the host tier's transitions (ISSUE 13) ---------------------------
+
+    def demote_cached(self, bid: int) -> None:
+        """The radix tree demotes a refcount-0 leaf toward the host tier:
+        the block leaves the tree's ownership but is NOT yet free — its
+        bytes must survive on the device until the staged D2H gather
+        copies them out (``free_demoted``). Not counted available, so the
+        reservation-soundness audit holds through the staging window."""
+        assert self._state[bid] == _CACHED, (
+            f"block {bid} demoted while not tree-owned"
+        )
+        self._state[bid] = _DEMOTED
+
+    def undemote(self, bid: int) -> None:
+        """Cancel a pending demotion: a prefix hit matched the demoted
+        node before its D2H copy ran, so the block's device bytes are
+        still canonical — hand ownership straight back to the tree (zero
+        copies, zero allocations)."""
+        assert self._state[bid] == _DEMOTED, (
+            f"block {bid} un-demoted while not staged (state "
+            f"{self._state[bid]})"
+        )
+        self._state[bid] = _CACHED
+
+    def free_demoted(self, bid: int) -> None:
+        """The staged D2H copy landed on the host: the device block is
+        finally reusable."""
+        assert self._state[bid] == _DEMOTED, (
+            f"block {bid} flushed while not staged for demotion"
         )
         self._state[bid] = _FREE
         self._free.append(bid)

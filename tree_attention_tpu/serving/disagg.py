@@ -29,8 +29,8 @@ entirely. This module is the in-process shape of that split:
   and the unspent worst-case reservation moves with the request — it is
   *transferred*, not re-reserved, so admission soundness holds across the
   handoff with no window in which a third request could steal the blocks.
-  (Under int8 the per-SLOT frozen scales — metadata, not KV — are copied
-  ``prefill slot -> decode slot`` in one small jitted update.)
+  (Under int8 the per-BLOCK scale scalars — ISSUE 13 — are POOL state and
+  relay with the KV arrays; the handoff itself moves no scale metadata.)
 
 **The handoff queue is the prefill slot itself.** A request whose final
 chunk completed parks in its prefill slot in state ``handoff`` until a
@@ -142,10 +142,15 @@ class DisaggServer:
       prefix_cache: shared radix reuse across the pair — the prefill
         worker matches/adopts against ONE :class:`PagedPrefixIndex`, the
         decode worker inherits each request's pins at handoff and
-        releases them at retire. Exact serving only: int8 blocks carry
-        per-slot frozen scales and cannot be shared, and the sidecar
-        gather pool cannot span two engines (pass
-        ``prefix_cache=False`` under ``quantize=True``).
+        releases them at retire. int8 serving shares too (ISSUE 13):
+        blocks carry per-BLOCK scales in the pool, so a published int8
+        block is self-contained on either worker.
+      host_blocks: KV tiering across the pair (ISSUE 13) — capacity of
+        the host-RAM demotion tier under the SHARED pool (0 = off).
+        The tier belongs to the shared radix tree: the prefill worker
+        (the matching side) runs the restores and the staged demotion
+        flushes; the relayed pool arrays keep both workers' views of a
+        restored block identical. Requires ``prefix_cache=True``.
     """
 
     def __init__(
@@ -174,18 +179,12 @@ class DisaggServer:
         speculate: bool = False,
         draft_k: int = 4,
         drafter: Union[str, Drafter, None] = None,
+        host_blocks: int = 0,
     ):
         if prefill_slots < 1 or decode_slots < 1:
             raise ValueError(
                 f"disaggregation needs >= 1 slot per pool, got "
                 f"prefill_slots={prefill_slots} decode_slots={decode_slots}"
-            )
-        if quantize and prefix_cache:
-            raise ValueError(
-                "disaggregated serving cannot share a prefix cache under "
-                "int8 (per-slot frozen scales make blocks unshareable; "
-                "the exact sidecar pool cannot span two engines) — pass "
-                "prefix_cache=False or quantize=False"
             )
         if kv_block is None:
             kv_block = prefix_block if prefix_cache else 64
@@ -206,6 +205,29 @@ class DisaggServer:
         # ownership transition — including the handoff's transfer — runs
         # through this allocator, so the soundness audit covers the pair.
         self.pool = BlockAllocator(self.kv_blocks)
+        if host_blocks < 0:
+            raise ValueError(f"host_blocks must be >= 0, got {host_blocks}")
+        if host_blocks and not prefix_cache:
+            raise ValueError(
+                "host_blocks KV tiering requires prefix_cache=True "
+                "(demotion is what radix eviction becomes; with no "
+                "radix tree nothing ever demotes)"
+            )
+        self.host_blocks = host_blocks
+        self.host_pool = None
+        if host_blocks:
+            from tree_attention_tpu.serving.host_pool import HostBlockPool
+
+            self.host_pool = HostBlockPool(
+                host_blocks,
+                n_layers=cfg.n_layers,
+                n_kv_heads=cfg.n_kv_heads,
+                block=kv_block,
+                d_head=cfg.d_head,
+                dtype=np.int8 if quantize else np.dtype(
+                    jnp.dtype(cfg.dtype).name),
+                quantized=quantize,
+            )
         self.prefix_index = None
         if prefix_cache:
             from tree_attention_tpu.serving.prefix_cache import (
@@ -215,6 +237,7 @@ class DisaggServer:
             self.prefix_index = PagedPrefixIndex(
                 block=kv_block, alloc=self.pool,
                 max_cached=prefix_pool_blocks,
+                host_pool=self.host_pool,
             )
         common = dict(
             cache_len=cache_len, mesh=mesh, quantize=quantize,
@@ -249,18 +272,30 @@ class DisaggServer:
             v=self.prefill.cache.v,
         )
         if quantize:
-            # Per-slot frozen scales are worker-local state; the handoff
-            # copies one slot's row across caches in one jitted update
-            # (scales are (L, 1, Hkv, 1, D) metadata — the KV itself
-            # never moves).
-            def _xfer_scales(dk, dv, sk, sv, p, d):
-                take = lambda buf: lax.dynamic_slice_in_dim(buf, p, 1, 1)
-                put = lambda buf, row: lax.dynamic_update_slice_in_dim(
-                    buf, row, d, axis=1
-                )
-                return put(dk, take(sk)), put(dv, take(sv))
-
-            self._xfer_scales = jax.jit(_xfer_scales)
+            # Per-BLOCK scales are POOL state (ISSUE 13), shared exactly
+            # like the KV pools: drop the decode worker's fresh scale
+            # arrays for the prefill worker's, and the per-dispatch
+            # relay below carries them — the handoff itself moves no
+            # scale metadata at all (it used to copy the per-slot frozen
+            # rows; per-block scales travel with their blocks for free).
+            self.decode.cache = dataclasses.replace(
+                self.decode.cache,
+                k_scale=self.prefill.cache.k_scale,
+                v_scale=self.prefill.cache.v_scale,
+            )
+        if self.host_pool is not None:
+            # KV tiering across the pair (ISSUE 13): the tier belongs to
+            # the SHARED tree, so the workers were built with
+            # host_blocks=0 and the pair wires the prefill worker — the
+            # matching side, where restores happen — as the tier's
+            # engine: its _paged_hit restores demoted paths, its
+            # _flush_demotions runs the staged D2H batches (registered
+            # as the shared allocator's flusher so a dry reservation on
+            # EITHER worker can force one; the relayed pool arrays make
+            # prefill.cache the live pool whichever worker dispatched
+            # last). The loop relays after restores and flushes at end
+            # of tick, mirroring SlotServer.serve.
+            self.prefill.attach_host_tier(self.host_pool)
         # Thread-safe control mailboxes — the ingress's seams. RLock: the
         # drain flag is flipped from SIGTERM handlers (the ingress's
         # install_drain_signals contract), which may interrupt a handler
@@ -325,6 +360,10 @@ class DisaggServer:
         if self.prefix_index is not None:
             out["blocks_cached"] = self.prefix_index.blocks_used
             out["pins"] = self.prefix_index.total_pins()
+        if self.host_pool is not None:
+            # Host-tier occupancy is legitimate retained cache (like
+            # blocks_cached), surfaced for the harness's accounting.
+            out["host_blocks_used"] = self.host_pool.used
         return out
 
     # -- the zero-copy handoff ---------------------------------------------
@@ -336,11 +375,14 @@ class DisaggServer:
         OTHER worker's cache still references the pre-step (possibly
         consumed) pool buffers; this host-side pointer swap — no device
         work — restores the single-pool invariant before the next
-        dispatch. Tables, lengths, and scales are per-worker and
-        untouched."""
-        dst.cache = dataclasses.replace(
-            dst.cache, k=src.cache.k, v=src.cache.v
-        )
+        dispatch. Tables and lengths are per-worker and untouched; the
+        per-BLOCK scale arrays (ISSUE 13) are POOL state like the KV
+        arrays and relay with them under int8."""
+        new = dict(k=src.cache.k, v=src.cache.v)
+        if self.quantize:
+            new.update(k_scale=src.cache.k_scale,
+                       v_scale=src.cache.v_scale)
+        dst.cache = dataclasses.replace(dst.cache, **new)
 
     def _adopt(self, p: int, d: int, tick: int,
                pending_reset: Dict[int, int]) -> None:
@@ -395,15 +437,6 @@ class DisaggServer:
         # for slot d (its prefill happened in the other worker's length
         # vector) — the slot's first decode dispatch resets it to plen.
         pending_reset[d] = plen
-        if self.quantize:
-            ks, vs = self._xfer_scales(
-                dc.cache.k_scale, dc.cache.v_scale,
-                pf.cache.k_scale, pf.cache.v_scale,
-                jnp.int32(p), jnp.int32(d),
-            )
-            dc.cache = dataclasses.replace(
-                dc.cache, k_scale=ks, v_scale=vs
-            )
         # Scrub the prefill slot WITHOUT releasing resources — they just
         # changed owner. No allocator generation bump either: nothing
         # became available, so a deferred admission must keep waiting.
@@ -483,6 +516,9 @@ class DisaggServer:
         peak_used = self.pool.used
         prefix0 = (self.prefix_index.stats()
                    if self.prefix_index is not None else None)
+        host0 = (self.host_pool.stats()
+                 if self.host_pool is not None else None)
+        hit_bytes0 = pf._hit_bytes_moved
         spec0 = (dc._spec_proposed, dc._spec_accepted, dc._spec_ticks,
                  dc._spec_verifies)
         pf._defer_gen = -1  # a stale latch must not defer a fresh run
@@ -500,6 +536,7 @@ class DisaggServer:
                 now = time.monotonic()
                 pf._tick_prefix_hits = 0
                 pf._tick_prefix_reused = 0
+                pf._tick_restored = 0
 
                 # Ingest newly visible requests (live invalids finish
                 # with outcome 'error'; static traces validated up front).
@@ -603,6 +640,11 @@ class DisaggServer:
                     slot = free.pop(0)
                     pf._admit(req, slot, tick,
                               visible_wall.pop(req.uid, now), resv)
+                if self.host_pool is not None and pf._tick_restored:
+                    # A hit on a demoted path just scattered restored
+                    # blocks into the (donated) pool arrays — relay so
+                    # the decode worker's next dispatch sees them.
+                    self._relay_pool(pf, dc)
                 queue_depth = len(pending)
                 if len(handoff_fifo) > queue_peak:
                     queue_peak = len(handoff_fifo)
@@ -744,6 +786,9 @@ class DisaggServer:
                         "queue_depth": queue_depth,
                         "prefix_hits": pf._tick_prefix_hits,
                         "prefix_reused": pf._tick_prefix_reused,
+                        **({"restored_blocks": pf._tick_restored,
+                            "host_blocks_used": self.host_pool.used}
+                           if self.host_pool is not None else {}),
                         "draining": draining,
                     })
 
@@ -898,6 +943,14 @@ class DisaggServer:
                 if self.pool.used > peak_used:
                     peak_used = self.pool.used
                 self.pool.publish_gauges()
+                if self.host_pool is not None:
+                    # The pair's staged D2H flush point (mirrors the
+                    # fused engine's end-of-tick flush): both workers
+                    # have dispatched, the relayed pool arrays are
+                    # current, and the fetch overlaps the loop's idle
+                    # gap toward the next tick's host work.
+                    pf._flush_demotions()
+                    self.host_pool.publish_gauge()
                 if FLIGHT.enabled:
                     FLIGHT.record({
                         "worker": "decode",
@@ -921,6 +974,11 @@ class DisaggServer:
                 })
             raise
 
+        if self.host_pool is not None:
+            # A drained run leaves no demotion staged: the ledger's
+            # _DEMOTED blocks would otherwise read as leaked capacity.
+            pf._flush_demotions()
+            self.host_pool.publish_gauge()
         if FLIGHT.enabled:
             FLIGHT.mark_idle()
         with self._lock:
@@ -943,7 +1001,9 @@ class DisaggServer:
                 "evictions": p1["evictions"] - prefix0["evictions"],
                 "pool_blocks_used": p1["pool_blocks_used"],
                 "pool_blocks": p1["pool_blocks"],
-                "hit_bytes_moved": 0,  # reference-in-place, always
+                # Reference-in-place for exact blocks; int8 hits count
+                # their dequant gather into staging (ISSUE 13).
+                "hit_bytes_moved": pf._hit_bytes_moved - hit_bytes0,
             }
         kv_snap = {
             "layout": "paged",
@@ -953,6 +1013,15 @@ class DisaggServer:
             "blocks_free": self.pool.free_count,
             "peak_blocks_used": peak_used,
         }
+        if self.host_pool is not None:
+            h1 = self.host_pool.stats()
+            kv_snap.update({
+                "host_blocks": h1["host_blocks"],
+                "host_blocks_used": h1["host_blocks_used"],
+                "demotions": h1["demotions"] - host0["demotions"],
+                "restores": h1["restores"] - host0["restores"],
+                "host_drops": h1["host_drops"] - host0["host_drops"],
+            })
         handoff_snap = {
             "handoffs": self.handoffs - handoffs0,
             "blocks_transferred": self.pool.transferred - transferred0,

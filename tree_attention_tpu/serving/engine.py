@@ -71,10 +71,16 @@ Variants:
   worst-case block count simply wait in the queue, so the pool can be
   sized well under ``slots × cache_len`` and the slot count can exceed
   what a contiguous layout could hold at equal bytes. int8 serving pages
-  the slot cache too, but keeps the exact-dtype sidecar pool for prefix
-  hits (per-slot frozen scales make int8 blocks unshareable — the
-  quantize-after-prefill contract). ``kv_layout="contiguous"`` keeps the
-  PR-5 layout.
+  the slot cache with per-BLOCK scale scalars riding the pool (ISSUE 13),
+  so quantized blocks publish into and hit from the same radix tree as
+  exact ones — the quantize-after-prefill contract holds at block
+  granularity, and hits dequant-gather the matched blocks into the
+  staging cache. With ``host_blocks > 0`` the pool grows a host-RAM
+  demotion tier under it: radix eviction demotes refcount-0 blocks
+  (staged D2H, one batched gather per tick) instead of freeing them, and
+  a hit on a demoted path restores it with one batched H2D scatter — the
+  effective prefix cache becomes host-RAM-sized.
+  ``kv_layout="contiguous"`` keeps the PR-5 layout.
 
 - ``speculate=True`` (ISSUE 8) turns every live slot's tick into a
   **draft-and-verify** step (speculative decoding, arXiv:2211.17192): a
@@ -143,12 +149,18 @@ from tree_attention_tpu.models.decode import (
     _sample,
     compact_decode_window,
     forward_step,
+    gather_kv_blocks,
     init_cache,
     init_paged_cache,
+    insert_dequant_prefix,
     paged_insert_slot,
     quantize_cache,
+    quantize_paged_blocks,
+    scatter_kv_blocks,
 )
 from tree_attention_tpu.serving.block_pool import BlockAllocator
+from tree_attention_tpu.serving.host_pool import HostBlockPool
+from tree_attention_tpu.serving.prefix_cache import TIER_DEVICE
 from tree_attention_tpu.serving.speculation import (
     Drafter,
     DraftProposal,
@@ -545,9 +557,10 @@ class SlotServer:
       prefix_cache: enable shared-prompt KV reuse — admissions match
         their prompt against a radix tree of published prefixes and skip
         prefill for the matched blocks (reference-in-place under the
-        paged layout — zero KV bytes moved; one pool gather under the
-        contiguous layout and under int8, whose per-slot frozen scales
-        need the exact sidecar pool).
+        paged layout — zero KV bytes moved, int8 included since
+        per-block scales made its blocks shareable (ISSUE 13); one pool
+        gather under the contiguous layout, whose int8 per-slot frozen
+        scales keep the exact sidecar pool).
       prefix_block: tokens per prefix pool block (power of two; the
         match/publish granularity). Under the paged layout this is also
         the default page size (``kv_block``) so matching stays
@@ -608,8 +621,18 @@ class SlotServer:
         .PagedPrefixIndex` over ``block_pool`` (the disaggregated pair
         shares one radix tree: the prefill worker matches/adopts, the
         decode worker holds the request's pins until retire). Implies
-        the prefix cache is on; exact paged serving only, and the
+        the prefix cache is on; paged serving only (int8 included since
+        per-block scales made int8 blocks shareable, ISSUE 13), and the
         index's block size must equal ``kv_block``.
+      host_blocks: KV tiering (ISSUE 13) — capacity of the host-RAM
+        demotion tier in blocks (``--host-blocks``; 0 = off). Radix
+        eviction then DEMOTES refcount-0 blocks into pinned host memory
+        (async D2H staged off the tick, one jitted gather per batch)
+        instead of freeing them, and a prefix hit on a demoted path
+        restores it with one batched H2D scatter into freshly allocated
+        device blocks — the effective prefix cache becomes
+        host-RAM-sized. Requires the paged layout and the prefix cache
+        (demotion IS radix eviction).
     """
 
     def __init__(
@@ -641,6 +664,7 @@ class SlotServer:
         drafter: Union[str, Drafter, None] = None,
         block_pool: Optional[BlockAllocator] = None,
         prefix_index: Optional[Any] = None,
+        host_blocks: int = 0,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -657,6 +681,14 @@ class SlotServer:
             raise ValueError(
                 "block_pool sharing requires kv_layout='paged' (the "
                 "contiguous layout has no block ledger to share)"
+            )
+        if host_blocks < 0:
+            raise ValueError(f"host_blocks must be >= 0, got {host_blocks}")
+        if host_blocks and kv_layout != "paged":
+            raise ValueError(
+                "host_blocks KV tiering requires kv_layout='paged' (the "
+                "tier demotes pool blocks; the contiguous layout has "
+                "none)"
             )
         if prefill_chunk < 1:
             raise ValueError(
@@ -722,6 +754,10 @@ class SlotServer:
             2 * cfg.n_layers * cfg.n_kv_heads * cfg.d_head
             * jnp.dtype(cfg.dtype).itemsize
         )
+        # int8 pool bytes per token — what an int8 paged hit's dequant
+        # gather into staging actually moves (ISSUE 13).
+        self._kv_token_bytes_q = 2 * cfg.n_layers * cfg.n_kv_heads \
+            * cfg.d_head
         if self._paged:
             if kv_block is None:
                 # Matching granularity == page size keeps radix hits
@@ -756,6 +792,38 @@ class SlotServer:
                     slots * self._npb if kv_blocks is None else kv_blocks
                 )
                 self._pool = BlockAllocator(self.kv_blocks)
+            # KV tiering (ISSUE 13): the host-RAM demotion tier under
+            # the device pool. Created here (the prefix index attaches
+            # to it below); the allocator's flusher hook lets a dry
+            # reservation force the staged D2H batch mid-tick, but the
+            # steady-state flush point is the end of the tick loop.
+            self.host_blocks = host_blocks
+            self._host_pool: Optional[HostBlockPool] = None
+            self._tick_restored = 0
+            if host_blocks:
+                if prefix_index is not None:
+                    raise ValueError(
+                        "host_blocks tiering with a shared prefix_index: "
+                        "build the index with its own host_pool instead "
+                        "(the tier belongs to the shared tree, not one "
+                        "engine)"
+                    )
+                if not prefix_cache:
+                    raise ValueError(
+                        "host_blocks KV tiering requires prefix_cache=True "
+                        "(demotion is what radix eviction becomes; with "
+                        "no radix tree nothing ever demotes)"
+                    )
+                self.attach_host_tier(HostBlockPool(
+                    host_blocks,
+                    n_layers=cfg.n_layers,
+                    n_kv_heads=cfg.n_kv_heads,
+                    block=kv_block,
+                    d_head=cfg.d_head,
+                    dtype=np.int8 if quantize else np.dtype(
+                        jnp.dtype(cfg.dtype).name),
+                    quantized=quantize,
+                ))
             self._host_table = np.zeros((slots, self._npb), np.int32)
             self._table_dirty = False  # device table starts all-zero too
             self._slot_nblocks = [0] * slots
@@ -769,6 +837,9 @@ class SlotServer:
                 block=kv_block, quantize=quantize, **kw
             )
         else:
+            self.host_blocks = 0
+            self._host_pool = None
+            self._tick_restored = 0
             cache = init_cache(cfg, slots, cache_len, **kw)
             if quantize:
                 cache = quantize_cache(cache)  # empty -> fallback scales
@@ -827,10 +898,10 @@ class SlotServer:
 
         # Prefix reuse (ISSUE 5/6): the radix tree, plus the per-slot ref
         # ledger — nodes a slot matched or published stay pinned
-        # (unevictable) until that slot retires. Paged exact serving uses
-        # the in-place index over the unified pool (zero-copy hits);
-        # contiguous — and int8, whose per-slot frozen scales make pool
-        # blocks unshareable — keep the PR-5 gather pool.
+        # (unevictable) until that slot retires. Paged serving — int8
+        # included, since per-block scales ride the pool (ISSUE 13) —
+        # uses the in-place index over the unified pool (zero-copy
+        # hits); the contiguous layout keeps the PR-5 gather pool.
         self._prefix: Optional[Any] = None
         self._paged_prefix = False
         self._slot_nodes: List[List[Any]] = [[] for _ in range(slots)]
@@ -841,13 +912,14 @@ class SlotServer:
             # Shared-radix mode (disaggregation): both workers hold pins
             # in ONE tree — the prefill worker matches and adopts, the
             # decode worker inherits the request's pins at handoff and
-            # releases them at retire. Only the exact paged index can be
-            # shared (int8 blocks carry per-slot frozen scales, and the
-            # contiguous gather pool owns its own device buffers).
-            if not self._paged or quantize:
+            # releases them at retire. Any paged index can be shared —
+            # int8 included, since per-block scales ride the shared pool
+            # (ISSUE 13) — but the contiguous gather pool owns its own
+            # device buffers and cannot.
+            if not self._paged:
                 raise ValueError(
-                    "prefix_index sharing requires exact paged serving "
-                    "(kv_layout='paged', quantize=False)"
+                    "prefix_index sharing requires paged serving "
+                    "(kv_layout='paged')"
                 )
             if block_pool is None or prefix_index.alloc is not block_pool:
                 raise ValueError(
@@ -870,7 +942,12 @@ class SlotServer:
                     f"prefix_block {prefix_block} exceeds cache_len "
                     f"{cache_len}"
                 )
-            if self._paged and not quantize:
+            if self._paged:
+                # The in-place index serves int8 too (ISSUE 13): blocks
+                # carry per-BLOCK scales in the pool, so a published
+                # int8 block is self-contained and shareable — the PR-5
+                # exact sidecar pool survives only for the contiguous
+                # layout.
                 from tree_attention_tpu.serving.prefix_cache import (
                     PagedPrefixIndex,
                 )
@@ -878,6 +955,7 @@ class SlotServer:
                 self._prefix = PagedPrefixIndex(
                     block=self.kv_block, alloc=self._pool,
                     max_cached=prefix_pool_blocks,
+                    host_pool=self._host_pool,
                 )
                 self._paged_prefix = True
             else:
@@ -913,6 +991,14 @@ class SlotServer:
             self._staging: KVCache = init_cache(
                 cfg, 1, cache_len, **self._prefill_kw
             )
+            if self._paged_prefix:
+                # int8 paged hits (ISSUE 13): the slot references the
+                # matched int8 blocks in place, but the suffix's exact
+                # staged prefill needs the prefix as activations-grade
+                # rows — ONE jitted dequant gather per hit.
+                self._dequant_hit = jax.jit(
+                    insert_dequant_prefix, donate_argnums=(0,)
+                )
 
         # jax.jit caches one executable per Tq bucket for the mixed step
         # (pure-decode ticks are the Tq=1 bucket, chunk ticks one of a
@@ -950,8 +1036,14 @@ class SlotServer:
         # cache on a >1-way seq mesh rides the tree merge) falls back to
         # root-path chains, which are exactly causal.
         self._drafter: Optional[Drafter] = None
-        self._tree_ok = not (kv_layout == "contiguous"
-                             and self._seq_shards > 1)
+        # Tree masks need a mask-plumbed attention path: the contiguous
+        # tree merge has none, and the paged-QUANT off-kernel path runs
+        # its dequantized view through the same merge under a seq mesh
+        # (ISSUE 13) — both fall back to root-path chains there.
+        self._tree_ok = not (
+            self._seq_shards > 1
+            and (kv_layout == "contiguous" or quantize)
+        )
         # Verify chunks ride power-of-two Tq buckets like prefill chunks;
         # the bucket must fit the cache's write window, so the draft size
         # clamps to the largest power of two <= min(32, cache_len).
@@ -1156,6 +1248,14 @@ class SlotServer:
                                         keepdims=False)  # (1, V)
         tok = self._sample(last, key)[0]
         if self.quantize:
+            if self._paged:
+                # Per-BLOCK quantization (ISSUE 13): each prompt block's
+                # scale is its own absmax, so the published blocks are
+                # self-contained and shareable through the radix tree.
+                kq, vq, ks, vs = quantize_paged_blocks(
+                    k, v, self.kv_block, plen
+                )
+                return kq, vq, ks, vs, tok
             qc = quantize_cache(KVCache(k=k, v=v, length=mini.length))
             return qc.k, qc.v, qc.k_scale, qc.v_scale, tok
         return k, v, tok
@@ -1221,14 +1321,20 @@ class SlotServer:
         return staging
 
     def _stage_final_fn(self, params, tokens, n_tok, staging, cache,
-                        tok_vec, slot, plen, reset, reset_val, key):
+                        tok_vec, slot, plen, reset, reset_val, key,
+                        lo=0):
         """The final chunk: finish the staged exact prefill, sample the
         first token from the last valid row, mask the stale tail, quantize
-        the staged prefix under its own frozen scales (the
-        quantize-after-prefill contract), and insert slot rows + scales +
-        length + first token into the batch cache — one dispatch, no host
-        sync (the token rides the per-tick fetch). Under the paged layout
-        the insert scatters through the slot's block table."""
+        the staged prompt (per-slot frozen channel scales on the
+        contiguous layout; per-BLOCK scalars on the paged one — the
+        quantize-after-prefill contract, at each layout's granularity),
+        and insert slot rows + scales + length + first token into the
+        batch cache — one dispatch, no host sync (the token rides the
+        per-tick fetch). Under the paged layout the insert scatters
+        through the slot's block table, skipping token positions below
+        ``lo`` — a prefix hit's matched blocks are SHARED (their staged
+        rows are the dequantized originals, which re-quantize to
+        bit-identical int8, so nothing is lost by not rewriting them)."""
         length = jnp.where(reset, reset_val, staging.length)
         staging = dataclasses.replace(staging, length=length)
         logits, staging = forward_step(
@@ -1241,28 +1347,35 @@ class SlotServer:
         valid = (
             jnp.arange(self.cache_len, dtype=jnp.int32) < plen
         )[None, None, None, :, None]
+        k_masked = jnp.where(valid, staging.k, 0)
+        v_masked = jnp.where(valid, staging.v, 0)
+        if self._paged:
+            kq, vq, ks, vs = quantize_paged_blocks(
+                k_masked, v_masked, self.kv_block, plen
+            )
+            new_cache = paged_insert_slot(
+                cache, slot, kq, vq, jnp.asarray(plen, jnp.int32),
+                ks, vs, lo=lo,
+            )
+            tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first,
+                                                      slot, axis=0)
+            return staging, new_cache, tok_vec
         qc = quantize_cache(KVCache(
-            k=jnp.where(valid, staging.k, 0),
-            v=jnp.where(valid, staging.v, 0),
+            k=k_masked,
+            v=v_masked,
             length=staging.length,
         ))
-        if self._paged:
-            new_cache = paged_insert_slot(
-                cache, slot, qc.k, qc.v, jnp.asarray(plen, jnp.int32),
-                qc.k_scale, qc.v_scale,
-            )
-        else:
-            put = lambda buf, new: lax.dynamic_update_index_in_dim(
-                buf, new[:, 0], slot, axis=1
-            )
-            new_cache = QuantKVCache(
-                k=put(cache.k, qc.k), v=put(cache.v, qc.v),
-                k_scale=put(cache.k_scale, qc.k_scale),
-                v_scale=put(cache.v_scale, qc.v_scale),
-                length=lax.dynamic_update_index_in_dim(
-                    cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
-                ),
-            )
+        put = lambda buf, new: lax.dynamic_update_index_in_dim(
+            buf, new[:, 0], slot, axis=1
+        )
+        new_cache = QuantKVCache(
+            k=put(cache.k, qc.k), v=put(cache.v, qc.v),
+            k_scale=put(cache.k_scale, qc.k_scale),
+            v_scale=put(cache.v_scale, qc.v_scale),
+            length=lax.dynamic_update_index_in_dim(
+                cache.length, jnp.asarray(plen, jnp.int32), slot, axis=0
+            ),
+        )
         tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot,
                                                   axis=0)
         return staging, new_cache, tok_vec
@@ -1333,6 +1446,10 @@ class SlotServer:
             "blocks_cached": 0,
             "pins": 0,
         }
+        if self._host_pool is not None:
+            # Host-tier occupancy is legitimate retained cache (like
+            # blocks_cached), surfaced for the harness's accounting.
+            out["host_blocks_used"] = self._host_pool.used
         if self._prefix is not None:
             out["blocks_cached"] = self._prefix.blocks_used
             out["pins"] = self._prefix.total_pins()
@@ -1433,18 +1550,21 @@ class SlotServer:
                                                              int]]:
         """Match (pinning the path) + reserve the admission's worst-case
         private blocks; ``None`` defers the admission — the request waits
-        in the queue until retires/evictions free blocks. Exact paged
-        serving subtracts the prefix hit's shared blocks from the
-        reservation (the sharing that lets slot-count exceed pool bytes);
-        int8 reserves the full span (its hits land in the exact staging
-        cache, not the slot's blocks)."""
+        in the queue until retires/evictions free blocks. The prefix
+        hit's DEVICE-resident shared blocks subtract from the reservation
+        (the sharing that lets slot-count exceed pool bytes — int8
+        included now that per-block scales make its blocks shareable);
+        a matched node sitting on the HOST tier still costs one
+        reservation, because restoring it allocates a fresh device block
+        (the restore consumes exactly that reservation in _paged_hit)."""
         total = -(-(len(req.prompt) + req.max_new_tokens) // self.kv_block)
         matched, nodes = 0, []
         if self._paged_prefix:
             matched, nodes = self._prefix.match(
                 np.asarray(req.prompt, np.int32), record=False
             )
-        needed = total - matched // self.kv_block
+        dev_matched = sum(1 for n in nodes if n.tier == TIER_DEVICE)
+        needed = total - dev_matched
         if not self._pool.reserve(needed):
             if nodes:
                 self._prefix.release(nodes)
@@ -1481,6 +1601,60 @@ class SlotServer:
                 self.cache, table=jnp.asarray(self._host_table)
             )
             self._table_dirty = False
+
+    def attach_host_tier(self, host_pool: HostBlockPool) -> None:
+        """Wire ``host_pool`` as this engine's KV demotion tier: build
+        the demote-gather / restore-scatter jits (the ONE home of their
+        donation recipe) and register the staged-flush hook on the
+        allocator. Called by ``__init__`` for ``host_blocks=`` and by
+        ``DisaggServer`` to make the prefill worker the SHARED tree's
+        tier engine (there the pool was built by the pair, the index
+        already points at it, and the relayed pool arrays make this
+        engine's cache the live pool whichever worker dispatched last)."""
+        self._host_pool = host_pool
+        self.host_blocks = host_pool.blocks
+        self._demote_gather = jax.jit(gather_kv_blocks)
+        self._restore_scatter = jax.jit(
+            scatter_kv_blocks,
+            donate_argnums=(0, 1) if not self.quantize
+            else (0, 1, 5, 6),
+        )
+        self._pool.set_demote_flusher(self._flush_demotions)
+
+    def _flush_demotions(self) -> int:
+        """Complete every staged demotion: ONE jitted gather over the
+        batch of pending device blocks, one D2H fetch, then the blocks
+        free. Called at the end of each tick (off the tick's dispatch
+        path — the gather queues behind the tick's step and the fetch
+        happens where the loop would otherwise idle) and by a dry
+        allocator mid-tick (rare; the batch amortisation is the point).
+        Returns how many device blocks it freed."""
+        hp = self._host_pool
+        if hp is None or not hp.pending:
+            return 0
+        items = hp.take_pending()
+        rows = [r for r, _ in items]
+        bids = [b for _, b in items]
+        nb = _bucket(len(bids), max(self._npb, len(bids)), floor=1)
+        ids = np.zeros((nb,), np.int32)  # pad gathers block 0; ignored
+        ids[:len(bids)] = bids
+        if self.quantize:
+            out = self._demote_gather(
+                self.cache.k, self.cache.v, jnp.asarray(ids),
+                self.cache.k_scale, self.cache.v_scale,
+            )
+        else:
+            out = self._demote_gather(
+                self.cache.k, self.cache.v, jnp.asarray(ids)
+            )
+        hp.commit(rows, *out)  # the D2H fetch happens inside commit
+        for b in bids:
+            self._pool.free_demoted(b)
+        if obs.TRACER.active:
+            obs.instant("kv_demote_flush", cat="serving", args={
+                "blocks": len(bids),
+            })
+        return len(bids)
 
     def _admit(self, req: Request, slot: int, tick: int,
                visible_at: float,
@@ -1584,22 +1758,108 @@ class SlotServer:
             })
         return matched
 
+    def _restore_demoted(self, slot: int, nodes: List[Any]) -> int:
+        """Bring a pinned path's host-tier nodes back onto the device:
+        still-pending demotions cancel in place (zero copies); flushed
+        ones take fresh device blocks from the slot's reservation and
+        land in ONE batched H2D scatter. Returns how many blocks were
+        restored (either arc — each was a device-capacity miss the host
+        tier absorbed)."""
+        demoted = self._prefix.demoted_in(nodes)
+        if not demoted:
+            return 0
+
+        def take_one() -> int:
+            assert self._slot_reserve[slot] > 0, (
+                f"slot {slot} restore outgrew its block reservation"
+            )
+            bid = self._pool.alloc()
+            self._slot_reserve[slot] -= 1
+            return bid
+
+        rows, bids = self._prefix.restore_nodes(demoted, take_one)
+        if rows:
+            hp = self._host_pool
+            staged = hp.read(rows)
+            nb = _bucket(len(bids), self._npb, floor=1)
+            ids = np.full((nb,), self.kv_blocks, np.int32)  # pad: dropped
+            ids[:len(bids)] = bids
+
+            def pad(a: np.ndarray) -> jax.Array:
+                out = np.zeros((nb,) + a.shape[1:], a.dtype)
+                out[:len(rows)] = a
+                return jnp.asarray(out)
+
+            if self.quantize:
+                hk, hv, hks, hvs = staged
+                k, v, ks, vs = self._restore_scatter(
+                    self.cache.k, self.cache.v, jnp.asarray(ids),
+                    pad(hk), pad(hv), self.cache.k_scale,
+                    self.cache.v_scale, pad(hks), pad(hvs),
+                )
+                self.cache = dataclasses.replace(
+                    self.cache, k=k, v=v, k_scale=ks, v_scale=vs
+                )
+            else:
+                hk, hv = staged
+                k, v = self._restore_scatter(
+                    self.cache.k, self.cache.v, jnp.asarray(ids),
+                    pad(hk), pad(hv),
+                )
+                self.cache = dataclasses.replace(self.cache, k=k, v=v)
+            for row in rows:
+                hp.release(row, restored=True)
+        return len(demoted)
+
     def _paged_hit(self, req: Request, slot: int, tick: int,
                    resv: Tuple[int, List[Any], int]) -> int:
-        """The reference-in-place hit (paged exact serving): write the
-        matched path's pool ids into the slot's table row and set the
-        prefill start — pure host bookkeeping, ZERO device KV bytes
-        moved (``bytes_moved=0`` on the instant is the measured claim,
-        not a slogan: the device sees nothing until the next dispatch
-        ships the updated int32 table)."""
+        """The reference-in-place hit (paged serving): write the matched
+        path's pool ids into the slot's table row and set the prefill
+        start — pure host bookkeeping, ZERO device KV bytes moved on the
+        exact tier (``bytes_moved=0`` on the instant is the measured
+        claim, not a slogan: the device sees nothing until the next
+        dispatch ships the updated int32 table). Demoted path nodes
+        restore FIRST (one batched H2D scatter; their bytes are the
+        restore cost, amortized into the admission like the suffix's
+        chunks). int8 hits additionally dequant-gather the matched
+        blocks into the staging cache — the suffix's exact staged
+        prefill attends them as activations-grade rows — and THOSE are
+        the bytes the instant reports for int8."""
         matched, nodes, _ = resv
         self._slot_nodes[slot] = nodes
         if not matched:
             return 0
+        restored = 0
+        if self._host_pool is not None:
+            restored = self._restore_demoted(slot, nodes)
+            self._tick_restored += restored
         for j, node in enumerate(nodes):
             self._host_table[slot, j] = node.block_id
         self._slot_nblocks[slot] = matched // self.kv_block
         self._table_dirty = True
+        moved = 0
+        if self.quantize:
+            # Dequantize the matched int8 blocks into staging slot 0 so
+            # the suffix's staged chunks see the prefix. One jitted
+            # donated gather; re-quantizing at final chunk reproduces
+            # the shared blocks' bytes exactly, so they are never
+            # rewritten (paged_insert_slot's ``lo``). The bucket cap is
+            # FLOOR-div (the staged window nb*kv_block must fit inside
+            # the staging cache — ceil would overhang a cache_len that
+            # is not block-divisible; same rule as PrefixCache's
+            # _nb_bucket); a matched path is at most
+            # (cache_len - 1) // kv_block blocks, so the cap holds.
+            nb = _bucket(len(nodes), self.cache_len // self.kv_block,
+                         floor=1)
+            ids = np.zeros((nb,), np.int32)
+            ids[:len(nodes)] = [n.block_id for n in nodes]
+            self._staging = self._dequant_hit(
+                self._staging, self.cache.k, self.cache.v,
+                self.cache.k_scale, self.cache.v_scale,
+                jnp.asarray(ids), jnp.int32(matched),
+            )
+            moved = matched * self._kv_token_bytes_q
+            self._hit_bytes_moved += moved
         self._tick_prefix_hits += 1
         self._tick_prefix_reused += matched
         if obs.TRACER.active:
@@ -1607,7 +1867,9 @@ class SlotServer:
                 "rid": req.uid, "slot": slot, "tick": tick,
                 "matched_tokens": matched,
                 "prompt_len": len(req.prompt),
-                "bytes_moved": 0,
+                "bytes_moved": moved,
+                **({"restored_blocks": restored}
+                   if self._host_pool is not None else {}),
             })
         return matched
 
@@ -1988,6 +2250,7 @@ class SlotServer:
                 self.params, jnp.asarray(mat), n_vec, self._staging,
                 self.cache, self.tok, jnp.int32(slot), jnp.int32(plen),
                 reset, reset_val, sub,
+                jnp.int32(self._prefill_start[slot]),
             )
             # The staging cache now holds the prompt's EXACT rows (the
             # quantized copy went into the slot) — publish before the
@@ -2129,6 +2392,8 @@ class SlotServer:
         if self._paged:
             self._peak_blocks_used = self._pool.used
             self._defer_gen = -1  # stale latch must not defer a fresh run
+        host0 = (self._host_pool.stats()
+                 if self._host_pool is not None else None)
         t0 = time.monotonic()
 
         try:
@@ -2141,6 +2406,7 @@ class SlotServer:
                 now = time.monotonic()
                 self._tick_prefix_hits = 0
                 self._tick_prefix_reused = 0
+                self._tick_restored = 0
                 self._tick_spec = (0, 0, 0)
                 self._tick_cancelled = 0
                 self._tick_deadline = 0
@@ -2624,6 +2890,14 @@ class SlotServer:
                     if self._pool.used > self._peak_blocks_used:
                         self._peak_blocks_used = self._pool.used
                     self._pool.publish_gauges()  # registry-guarded inside
+                if self._host_pool is not None:
+                    # The staged D2H flush point: demotions this tick's
+                    # evictions enqueued complete as ONE batched gather,
+                    # after the tick's dispatches (the fetch overlaps
+                    # where the loop would otherwise idle toward the
+                    # next tick's host work).
+                    self._flush_demotions()
+                    self._host_pool.publish_gauge()  # registry-guarded
 
                 # The flight recorder's per-tick record (the black box a
                 # post-mortem replays); record dict built only when armed.
@@ -2674,6 +2948,9 @@ class SlotServer:
                         rec["kv_frag"] = round(
                             1.0 - written / (mapped * self.kv_block), 4
                         ) if mapped else 0.0
+                        if self._host_pool is not None:
+                            rec["host_blocks_used"] = self._host_pool.used
+                            rec["restored_blocks"] = self._tick_restored
                     if self._speculate:
                         s_slots, s_prop, s_acc = self._tick_spec
                         rec["spec_verify"] = {
@@ -2699,6 +2976,11 @@ class SlotServer:
                 })
             raise
 
+        if self._host_pool is not None:
+            # A drained run leaves no demotion staged: the ledger's
+            # _DEMOTED blocks would otherwise read as leaked capacity.
+            self._flush_demotions()
+            self._host_pool.publish_gauge()
         if FLIGHT.enabled:
             # Drained, not wedged: /healthz stays 200 "idle" between runs
             # however long this run's last tick ages.
@@ -2745,6 +3027,15 @@ class SlotServer:
                 "blocks_free": self._pool.free_count,
                 "peak_blocks_used": self._peak_blocks_used,
             }
+            if self._host_pool is not None:
+                h1 = self._host_pool.stats()
+                kv_snap.update({
+                    "host_blocks": h1["host_blocks"],
+                    "host_blocks_used": h1["host_blocks_used"],
+                    "demotions": h1["demotions"] - host0["demotions"],
+                    "restores": h1["restores"] - host0["restores"],
+                    "host_drops": h1["host_drops"] - host0["host_drops"],
+                })
         spec_snap: Dict[str, Any] = {}
         if self._speculate:
             prop = self._spec_proposed - spec0[0]

@@ -1,0 +1,251 @@
+"""Host-RAM block tier under the device KV pool (ISSUE 13).
+
+Device pool capacity is the hard ceiling on prefix retention: the radix
+tree's LRU eviction *frees* refcount-0 blocks, so every evicted prefix
+is a future cold prefill. This module turns that eviction into
+**demotion** (SGLang's hierarchical-cache direction, extending
+RadixAttention — arXiv:2312.07104): evicted blocks park in a
+preallocated host-side block pool, the radix node keeps existing with a
+``tier`` bit flipped to *host*, and a later prefix hit **restores** the
+path with one batched H2D scatter into freshly allocated device blocks.
+The effective prefix cache becomes host-RAM-sized; only the working set
+pays device bytes.
+
+Mechanics, in the order a block travels:
+
+- **Demote (staged)**: the tree picks its LRU victim and calls
+  :meth:`HostBlockPool.enqueue` — the device block enters the
+  allocator's ``demoted`` ledger state (not reusable yet!) and a
+  (host row ← device block) pair joins the pending queue. No device
+  work happens here.
+- **Flush**: the engine drains the pending queue OFF the tick — one
+  jitted gather over the whole batch, one D2H fetch — then the device
+  blocks finally free (:meth:`BlockAllocator.free_demoted`). A dry
+  allocator can force a mid-tick flush, but the steady state is one
+  batched gather per tick at most.
+- **Restore**: a prefix hit on a demoted node either *cancels* a
+  still-pending demotion (the device bytes never left — zero copies) or
+  allocates a fresh device block from the admission's reservation and
+  rides ONE batched H2D scatter for the whole path. Restore is
+  bit-exact on the exact tier: the bytes are copied, not recomputed.
+- **Drop**: a full host pool evicts ITS LRU refcount-0 leaf — the node
+  disappears from the tree entirely, exactly like a classic eviction
+  (the ``free→…→demoted→restored|dropped`` lifecycle in
+  ARCHITECTURE.md).
+
+Storage is plain page-locked-equivalent host memory (numpy arrays — on
+a real TPU host you would back this with pinned allocations so the DMA
+engine can stream it; the CPU proxy has no distinction). int8 pools
+carry their per-block scale scalars alongside the KV bytes, so a
+restored quantized block dequantizes exactly as it did before demotion.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tree_attention_tpu import obs
+from tree_attention_tpu.utils.logging import get_logger
+
+log = get_logger("serving.host_pool")
+
+_HOST_USED = obs.gauge(
+    "serving_kv_host_blocks_used",
+    "host-tier KV blocks currently holding a demoted span",
+)
+_DEMOTIONS = obs.counter(
+    "serving_kv_demotions_total",
+    "device KV blocks demoted toward the host tier (counted at enqueue)",
+)
+_RESTORES = obs.counter(
+    "serving_kv_restores_total",
+    "demoted KV blocks restored to the device tier (H2D copies and "
+    "cancelled-pending restores both count — each was a device-capacity "
+    "miss the host tier absorbed)",
+)
+
+
+class HostBlockPool:
+    """A fixed pool of ``blocks`` host-RAM KV blocks + the staging queue.
+
+    Args:
+      blocks: host-tier capacity, in blocks (the ``--host-blocks`` knob).
+      n_layers / n_kv_heads / block / d_head: the block geometry — must
+        match the device pool's.
+      dtype: the device pool's numpy dtype (``int8`` under quantized
+        serving, the model dtype otherwise).
+      quantized: also carry per-block scale scalars ``(L, Hkv)`` per
+        block for K and V (the shareable-int8 contract, ISSUE 13).
+
+    Single-threaded by design: every method runs on the engine loop
+    thread (the ingress's thread-safe seams stop at the engine's control
+    mailboxes). The D2H/H2D copies themselves are the CALLER's — this
+    class only owns the host bytes and the pending bookkeeping, so it
+    stays importable without jax.
+    """
+
+    def __init__(
+        self,
+        blocks: int,
+        *,
+        n_layers: int,
+        n_kv_heads: int,
+        block: int,
+        d_head: int,
+        dtype,
+        quantized: bool = False,
+    ):
+        if blocks < 1:
+            raise ValueError(f"host pool needs >= 1 block, got {blocks}")
+        self.blocks = blocks
+        self.block = block
+        shape = (blocks, n_layers, n_kv_heads, block, d_head)
+        self.k = np.zeros(shape, dtype)
+        self.v = np.zeros(shape, dtype)
+        self.quantized = quantized
+        if quantized:
+            sshape = (blocks, n_layers, n_kv_heads)
+            self.k_scale = np.ones(sshape, np.float32)
+            self.v_scale = np.ones(sshape, np.float32)
+        self._free: List[int] = list(range(blocks - 1, -1, -1))
+        # host row -> device block id, for demotions whose D2H copy has
+        # not run yet (their canonical bytes are still on the device).
+        self.pending: Dict[int, int] = {}
+        # Lifetime accounting (the engine snapshots + diffs per run).
+        self.demotions = 0
+        self.restores = 0
+        self.drops = 0
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def used(self) -> int:
+        return self.blocks - len(self._free)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def publish_gauge(self) -> None:
+        if obs.REGISTRY.enabled:
+            _HOST_USED.set(self.used)
+
+    # -- demote side ------------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """One free host row, or None when the tier is full (the caller —
+        the radix index — drops its host-LRU leaf and retries)."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def enqueue(self, row: int, device_bid: int) -> None:
+        """Stage one demotion: host ``row`` will receive device block
+        ``device_bid`` at the next flush. The device block must already
+        be in the allocator's ``demoted`` state."""
+        assert row not in self.pending, f"host row {row} double-staged"
+        self.pending[row] = device_bid
+        self.demotions += 1
+        if obs.REGISTRY.enabled:
+            _DEMOTIONS.inc()
+            _HOST_USED.set(self.used)
+
+    def take_pending(self) -> List[Tuple[int, int]]:
+        """Drain the staging queue for one flush: ``(host_row,
+        device_bid)`` pairs in a stable order. The caller owns the copy
+        and the ``free_demoted`` calls; rows stay allocated."""
+        items = sorted(self.pending.items())
+        self.pending.clear()
+        return items
+
+    def commit(
+        self,
+        rows: List[int],
+        k_rows: np.ndarray,
+        v_rows: np.ndarray,
+        k_scale: Optional[np.ndarray] = None,
+        v_scale: Optional[np.ndarray] = None,
+    ) -> None:
+        """Land one flushed batch: ``k_rows``/``v_rows`` are the gathered
+        ``(n, L, Hkv, block, D)`` device arrays for ``rows`` (same
+        order), plus the per-block scale scalars under quantized
+        serving. This is where the staged D2H fetch actually happens —
+        the ONE intended host sync of the tier, positioned off the
+        tick's dispatch path by the engine's flush scheduling."""
+        idx = np.fromiter(rows, np.int64, len(rows))
+        n = len(rows)
+        # lint: allow[host-sync] the staged D2H demotion batch lands here — one batched fetch per flush, off the tick
+        self.k[idx] = np.asarray(k_rows)[:n]
+        # lint: allow[host-sync] second half of the same staged D2H batch
+        self.v[idx] = np.asarray(v_rows)[:n]
+        if self.quantized:
+            # lint: allow[host-sync] per-block K scale scalars of the same batch
+            self.k_scale[idx] = np.asarray(k_scale)[:n]
+            # lint: allow[host-sync] per-block V scale scalars of the same batch
+            self.v_scale[idx] = np.asarray(v_scale)[:n]
+
+    # -- restore side -----------------------------------------------------
+
+    def cancel_pending(self, row: int) -> Optional[int]:
+        """If ``row``'s demotion has not flushed yet, cancel it: the
+        device block (returned) is still canonical, the host row frees.
+        None when the copy already landed (a real restore is needed)."""
+        bid = self.pending.pop(row, None)
+        if bid is None:
+            return None
+        self._free.append(row)
+        self.restores += 1
+        if obs.REGISTRY.enabled:
+            _RESTORES.inc()
+            _HOST_USED.set(self.used)
+        return bid
+
+    def read(self, rows: List[int]) -> Tuple[np.ndarray, ...]:
+        """The H2D staging view for a restore batch: stacked
+        ``(n, L, Hkv, block, D)`` K and V rows (+ scale scalars when
+        quantized), in ``rows`` order. Plain host reads."""
+        idx = np.fromiter(rows, np.int64, len(rows))
+        out = [self.k[idx], self.v[idx]]
+        if self.quantized:
+            out += [self.k_scale[idx], self.v_scale[idx]]
+        return tuple(out)
+
+    def release(self, row: int, *, restored: bool) -> None:
+        """Return one host row after a restore's H2D copy (``restored``)
+        or a drop of a flushed node. Pending rows go through
+        :meth:`cancel_pending` / :meth:`drop` instead."""
+        assert row not in self.pending, (
+            f"host row {row} released while still staged"
+        )
+        self._free.append(row)
+        if restored:
+            self.restores += 1
+            if obs.REGISTRY.enabled:
+                _RESTORES.inc()
+        else:
+            self.drops += 1
+        if obs.REGISTRY.enabled:
+            _HOST_USED.set(self.used)
+
+    def drop(self, row: int) -> Optional[int]:
+        """The host tier's own LRU eviction: the node is leaving the tree
+        entirely. Returns the device block id when the demotion was still
+        pending (the caller must ``free_demoted`` it — the copy never ran
+        and never will), else None (just the host row frees)."""
+        bid = self.pending.pop(row, None)
+        self._free.append(row)
+        self.drops += 1
+        if obs.REGISTRY.enabled:
+            _HOST_USED.set(self.used)
+        return bid
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "host_blocks": self.blocks,
+            "host_blocks_used": self.used,
+            "demotions": self.demotions,
+            "restores": self.restores,
+            "host_drops": self.drops,
+        }

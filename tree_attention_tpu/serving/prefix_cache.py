@@ -89,10 +89,19 @@ def _block_key(toks: List[int], j: int, block: int) -> Tuple[int, ...]:
     return tuple(toks[j * block:(j + 1) * block])
 
 
-class _Node:
-    """One radix node: a ``block``-token span owning one pool block."""
+# Node tiers (ISSUE 13): a DEVICE node's ``block_id`` names a device
+# pool block; a HOST node's names a row of the host tier
+# (:class:`~tree_attention_tpu.serving.host_pool.HostBlockPool`) —
+# demotion flips the bit down, a prefix-hit restore flips it back.
+TIER_DEVICE, TIER_HOST = 0, 1
 
-    __slots__ = ("key", "parent", "children", "block_id", "refs", "last_use")
+
+class _Node:
+    """One radix node: a ``block``-token span owning one pool block
+    (device tier) or one host-tier row (demoted)."""
+
+    __slots__ = ("key", "parent", "children", "block_id", "refs",
+                 "last_use", "tier")
 
     def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"],
                  block_id: int):
@@ -102,6 +111,7 @@ class _Node:
         self.block_id = block_id
         self.refs = 0
         self.last_use = 0
+        self.tier = TIER_DEVICE
 
 
 class _RadixBase:
@@ -188,19 +198,26 @@ class _RadixBase:
             total += n.refs
         return total
 
-    def _lru_leaf(self) -> Optional[_Node]:
-        """The least-recently-used refcount-0 leaf, or None when every
-        block is pinned (directly or through a pinned descendant)."""
+    def _lru_scan(self, victim) -> Optional[_Node]:
+        """The min-``last_use`` node satisfying ``victim(node)`` over the
+        whole tree, or None — the ONE traversal every LRU-victim rule
+        (classic leaf eviction, device-tier demotion, host-tier drop)
+        parameterizes."""
         best: Optional[_Node] = None
         stack = list(self._root.children.values())
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            if n.children or n.refs:
+            if not victim(n):
                 continue
             if best is None or n.last_use < best.last_use:
                 best = n
         return best
+
+    def _lru_leaf(self) -> Optional[_Node]:
+        """The least-recently-used refcount-0 leaf, or None when every
+        block is pinned (directly or through a pinned descendant)."""
+        return self._lru_scan(lambda n: not n.children and not n.refs)
 
 
 class PrefixCache(_RadixBase):
@@ -399,11 +416,19 @@ class PagedPrefixIndex(_RadixBase):
     """
 
     def __init__(self, *, block: int, alloc: "BlockAllocator",
-                 max_cached: Optional[int] = None):
+                 max_cached: Optional[int] = None,
+                 host_pool: Optional[Any] = None):
         self._init_tree(block)
         self.alloc = alloc
         self.max_cached = max_cached
-        self._cached = 0  # blocks the tree currently owns
+        self._cached = 0  # DEVICE blocks the tree currently owns
+        self._host_cached = 0  # demoted nodes (host-tier rows)
+        # KV tiering (ISSUE 13): with a host pool attached, eviction
+        # DEMOTES the LRU victim's block into it (the node survives with
+        # its tier bit flipped) instead of freeing, and a later match on
+        # the demoted path restores it — see host_pool.py's module
+        # docstring for the block's full journey.
+        self.host = host_pool
         alloc.set_evictor(self.evict_one, self.evictable_blocks)
 
     # -- stats (same vocabulary as PrefixCache; the engine snapshots) -----
@@ -413,7 +438,7 @@ class PagedPrefixIndex(_RadixBase):
         return self._cached
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "hits": self.hits,
             "misses": self.misses,
             "tokens_reused": self.tokens_reused,
@@ -422,6 +447,9 @@ class PagedPrefixIndex(_RadixBase):
             "pool_blocks": (self.max_cached if self.max_cached is not None
                             else self.alloc.blocks),
         }
+        if self.host is not None:
+            out.update(self.host.stats())
+        return out
 
     # -- match / pin (identical contract to PrefixCache.match) ------------
 
@@ -507,14 +535,73 @@ class PagedPrefixIndex(_RadixBase):
             _POOL_USED.set(self._cached)
         return path, adopted
 
-    # -- eviction (the allocator's hook) ----------------------------------
+    # -- eviction / demotion (the allocator's hook) -----------------------
+
+    def _lru_device_victim(self) -> Optional[_Node]:
+        """The LRU refcount-0 DEVICE-tier node with no device-tier
+        children, or None when every device block is pinned. Without a
+        host tier this is exactly the classic refcount-0 leaf (host
+        nodes never exist); with one, a device node whose children were
+        all demoted already is a valid victim — demoting it keeps the
+        node (and its host subtree's prefix) intact."""
+        return self._lru_scan(
+            lambda n: n.tier == TIER_DEVICE and not n.refs
+            and not any(c.tier == TIER_DEVICE
+                        for c in n.children.values())
+        )
+
+    def _drop_host_lru(self) -> bool:
+        """The host tier's own LRU eviction: delete the least-recently-
+        used refcount-0 host-tier LEAF from the tree (the ``dropped``
+        arc — same leaf-only discipline as device eviction, so no
+        prefix is ever orphaned). A still-pending demotion's device
+        block frees directly: its copy never ran and never will."""
+        best = self._lru_scan(
+            lambda n: n.tier == TIER_HOST and not n.refs
+            and not n.children
+        )
+        if best is None:
+            return False
+        del best.parent.children[best.key]
+        bid = self.host.drop(best.block_id)
+        if bid is not None:
+            self.alloc.free_demoted(bid)
+        self._host_cached -= 1
+        return True
 
     def evict_one(self) -> bool:
-        """Free one LRU refcount-0 leaf into the allocator; False when
-        every cached block is pinned (directly or through a pinned
-        descendant)."""
-        victim = self._lru_leaf()
+        """Recycle one LRU refcount-0 device victim: DEMOTE it into the
+        host tier when one is attached (the node survives — a later
+        match restores it), plain-evict otherwise (or when the host tier
+        is pinned full even after dropping its own LRU). False when
+        every device block is pinned."""
+        victim = self._lru_device_victim()
         if victim is None:
+            return False
+        if self.host is not None:
+            row = self.host.alloc()
+            while row is None and self._drop_host_lru():
+                row = self.host.alloc()
+            if row is not None:
+                self.alloc.demote_cached(victim.block_id)
+                self.host.enqueue(row, victim.block_id)
+                victim.tier = TIER_HOST
+                victim.block_id = row
+                self._cached -= 1
+                self._host_cached += 1
+                self.evictions += 1
+                if obs.REGISTRY.enabled:
+                    _POOL_USED.set(self._cached)
+                return True
+            log.debug("host tier pinned full; falling back to eviction")
+        # Classic eviction: the prefix is forgotten. A demoted-tier
+        # victim never reaches here (victims are device-tier), so the
+        # only children it could orphan are host nodes — and a device
+        # victim with host children only falls through when the host
+        # tier could not take it, in which case its host subtree must
+        # drop with it (leaf-first, so it is already empty: _drop_host_lru
+        # failing means every host leaf is pinned, which pins this path).
+        if victim.children:
             return False
         del victim.parent.children[victim.key]
         self.alloc.free_cached(victim.block_id)
@@ -525,22 +612,66 @@ class PagedPrefixIndex(_RadixBase):
         return True
 
     def evictable_blocks(self) -> int:
-        """Blocks in fully-unpinned subtrees — exactly what repeated
-        :meth:`evict_one` calls can reach (leaf-first eviction drains an
-        unpinned subtree completely; a pinned descendant protects every
-        ancestor on its path)."""
+        """DEVICE blocks in fully-unpinned subtrees — exactly what
+        repeated :meth:`evict_one` calls can reach (device-leaf-first
+        eviction drains an unpinned subtree's device blocks completely;
+        a pinned descendant protects every ancestor on its path).
+        Host-tier nodes hold no device block and count 0."""
 
         def walk(node: _Node) -> Tuple[bool, int, int]:
             has_pin = node.refs > 0
-            blocks = 1
+            dev_blocks = 1 if node.tier == TIER_DEVICE else 0
             kid_evictable = 0
             for c in node.children.values():
                 p, b, e = walk(c)
                 has_pin |= p
-                blocks += b
+                dev_blocks += b
                 kid_evictable += e
             if has_pin:
-                return True, blocks, kid_evictable
-            return False, blocks, blocks
+                return True, dev_blocks, kid_evictable
+            return False, dev_blocks, dev_blocks
 
         return sum(walk(c)[2] for c in self._root.children.values())
+
+    # -- restore (the engine's hit path, ISSUE 13) ------------------------
+
+    def demoted_in(self, nodes: List[_Node]) -> List[_Node]:
+        """The host-tier nodes of a matched (pinned) path, path order."""
+        return [n for n in nodes if n.tier == TIER_HOST]
+
+    def restore_nodes(
+        self, nodes: List[_Node], alloc_device: Any
+    ) -> Tuple[List[int], List[int]]:
+        """Bring a pinned path's demoted nodes back to the device tier.
+
+        Two arcs per node: a still-PENDING demotion cancels (the device
+        bytes never left — the block hands straight back to the tree,
+        zero copies, zero allocations); a flushed one takes a fresh
+        device block from ``alloc_device()`` (the admission's
+        reservation backs it) and joins the batched H2D scatter the
+        caller dispatches. Returns ``(host_rows, new_bids)`` — equal-
+        length lists of the rows to copy and their destination blocks;
+        the caller reads the rows (:meth:`HostBlockPool.read`), scatters,
+        then releases them. Tier bits and ownership flip here, so the
+        tree's view is consistent the moment this returns."""
+        rows: List[int] = []
+        bids: List[int] = []
+        for n in nodes:
+            assert n.tier == TIER_HOST and n.refs > 0, \
+                "restore of an unpinned or device-tier node"
+            row = n.block_id
+            bid = self.host.cancel_pending(row)
+            if bid is not None:
+                self.alloc.undemote(bid)
+            else:
+                bid = alloc_device()
+                self.alloc.publish(bid)  # private -> tree-owned
+                rows.append(row)
+                bids.append(bid)
+            n.block_id = bid
+            n.tier = TIER_DEVICE
+            self._cached += 1
+            self._host_cached -= 1
+        if obs.REGISTRY.enabled:
+            _POOL_USED.set(self._cached)
+        return rows, bids

@@ -90,10 +90,6 @@ class RunConfig:
     slo_tbt: float = 0.2     # worst inter-token-gap target (s), ditto
     prefix_cache: bool = False  # radix prefix KV reuse across requests
     prefix_block: int = 64   # pool block granularity (tokens, pow2)
-    # DEPRECATED (ISSUE 6): the paged layout has ONE --kv-blocks budget;
-    # a value given here feeds it (with a warning). Contiguous layout
-    # still uses it as the separate prefix pool's size (default 64).
-    prefix_pool_blocks: Optional[int] = None
     prefix_share: float = 0.0  # trace: fraction of requests sharing a prefix
     prefix_len: int = 0      # trace: shared prefix length (tokens)
     kv_layout: str = "paged"  # paged (one block pool) | contiguous (PR-5)
@@ -101,6 +97,12 @@ class RunConfig:
     #                                 prefix-block with the cache on, else 64)
     kv_blocks: Optional[int] = None  # TOTAL pool capacity in blocks (None ->
     #                                  slots * ceil(cache_len / kv_block))
+    # Hierarchical KV tiering (ISSUE 13): radix eviction demotes blocks
+    # onto a host-RAM tier instead of freeing them; a later prefix hit
+    # restores them with one batched H2D scatter.
+    host_blocks: int = 0     # host-tier capacity in blocks (0 = no tier)
+    kv_tiering: str = "on"   # on | off — off ignores --host-blocks (the
+    #                          bench's A/B switch at one config)
     speculate: bool = False  # draft-and-verify speculative decoding
     draft_k: int = 4         # max draft tokens per slot per verify tick
     drafter: str = "ngram"   # ngram | ngram-tree | model
@@ -300,15 +302,6 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefix-block", type=int, default=d.prefix_block,
                    help="serve mode: prefix pool block size in tokens "
                         "(power of two; the match/publish granularity)")
-    p.add_argument("--prefix-pool-blocks", type=int,
-                   default=d.prefix_pool_blocks,
-                   help="DEPRECATED: use the unified --kv-blocks budget. "
-                        "Under --kv-layout paged a value given here is "
-                        "added onto the derived --kv-blocks total (the "
-                        "old slot-cache + prefix-pool split, preserved "
-                        "byte-for-byte) with a warning; under "
-                        "--kv-layout contiguous it still sizes the "
-                        "separate prefix pool (default 64)")
     p.add_argument("--kv-layout", choices=["paged", "contiguous"],
                    default=d.kv_layout,
                    help="serve mode: 'paged' (default) holds every "
@@ -329,6 +322,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "kv_block), the contiguous layout's bytes). "
                         "Smaller over-subscribes: admissions wait for "
                         "free blocks instead of failing")
+    p.add_argument("--host-blocks", type=int, default=d.host_blocks,
+                   help="serve mode: host-RAM KV tier capacity in blocks "
+                        "(0 = no tier). With the paged layout + prefix "
+                        "cache, radix eviction DEMOTES refcount-0 blocks "
+                        "into pinned host memory (async D2H, one batched "
+                        "gather per tick) instead of freeing them, and a "
+                        "prefix hit on a demoted path restores it with "
+                        "one batched H2D scatter — the effective prefix "
+                        "cache becomes host-RAM-sized (SGLang's "
+                        "hierarchical cache direction)")
+    p.add_argument("--kv-tiering", choices=["on", "off"],
+                   default=d.kv_tiering,
+                   help="serve mode: 'off' ignores --host-blocks (radix "
+                        "eviction frees blocks, the pre-tiering "
+                        "behavior) — the A/B switch the tiered-KV bench "
+                        "flips at one otherwise-identical config")
     p.add_argument("--speculate", action="store_true", default=d.speculate,
                    help="serve mode: draft-and-verify speculative "
                         "decoding (arXiv:2211.17192) on the mixed-Tq "
