@@ -312,6 +312,63 @@ class TestExitArcs:
         assert out[1].outcome == "shed" and out[1].tokens == []
         assert_drained(srv)
 
+    def test_flight_records_carry_robustness_counters(self, params):
+        # Regression (ISSUE 14 mirror burn-down): the disagg tick's
+        # flight records dropped the fused engine's per-tick robustness
+        # counters (cancelled / deadline_expired / shed) — a black-box
+        # storm read identically to a healthy one. Pin the keys AND that
+        # a swept deadline actually lands in them.
+        from tree_attention_tpu.obs.flight import FLIGHT
+
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        reqs = [
+            Request(uid=20, prompt=LOOP_PROMPT, max_new_tokens=4),
+            Request(uid=21, prompt=ALT_PROMPT, max_new_tokens=4,
+                    deadline_s=time.monotonic() - 1.0),  # already dead
+        ]
+        FLIGHT.clear()
+        FLIGHT.arm()
+        try:
+            srv.serve(reqs)
+        finally:
+            FLIGHT.disarm()
+        # The prefill worker's record holds the pair's sweep stats
+        # (the sweep runs once per tick, before either worker's body).
+        recs = [r for r in FLIGHT.snapshot()["records"]
+                if r.get("worker") == "prefill"]
+        FLIGHT.clear()
+        assert recs
+        for key in ("cancelled", "deadline_expired", "shed"):
+            assert all(key in r for r in recs), key
+        assert sum(r["deadline_expired"] for r in recs) == 1
+        assert sum(r["cancelled"] for r in recs) == 0
+        assert sum(r["shed"] for r in recs) == 0
+        assert_drained(srv)
+
+    def test_sweep_only_tick_still_records_flight_counters(self, params):
+        # Review finding (ISSUE 14): when the sweep retired EVERY piece
+        # of queued work on a tick with no slots in flight, the idle
+        # path broke out of the loop before the flight record and the
+        # counters vanished — the disagg twin of the fused engine's
+        # sweep-only record.
+        from tree_attention_tpu.obs.flight import FLIGHT
+
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        reqs = [Request(uid=22, prompt=ALT_PROMPT, max_new_tokens=4,
+                        deadline_s=time.monotonic() - 1.0)]
+        FLIGHT.clear()
+        FLIGHT.arm()
+        try:
+            srv.serve(reqs)
+        finally:
+            FLIGHT.disarm()
+        recs = [r for r in FLIGHT.snapshot()["records"]
+                if r.get("worker") == "prefill"]
+        FLIGHT.clear()
+        swept = [r for r in recs if r.get("sweep_only")]
+        assert len(swept) == 1 and swept[0]["deadline_expired"] == 1
+        assert_drained(srv)
+
 
 # ---------------------------------------------------------------------------
 # the allocator's transfer audit + construction contracts

@@ -274,6 +274,31 @@ def test_deadline_expired_in_queue_is_rejected_unserved(params):
     assert_leak_free(eng)
 
 
+def test_sweep_only_tick_still_records_flight_counters(params):
+    """Review finding (ISSUE 14): a sweep that retired work but left the
+    tick idle (every queued request dead on arrival, no slots in flight)
+    broke out of the loop BEFORE the flight record — the counters were
+    zeroed at the next tick top and the storm vanished from the black
+    box."""
+    from tree_attention_tpu.obs.flight import FLIGHT
+
+    eng = base_engine(params)
+    req = Request(uid=610, prompt=SHORT_PROMPT, max_new_tokens=4,
+                  deadline_s=time.monotonic() - 1.0)  # dead on arrival
+    FLIGHT.clear()
+    FLIGHT.arm()
+    try:
+        rep = eng.serve([req])
+    finally:
+        FLIGHT.disarm()
+    recs = FLIGHT.snapshot()["records"]
+    FLIGHT.clear()
+    assert rep.results[0].outcome == OUTCOME_DEADLINE
+    swept = [r for r in recs if r.get("sweep_only")]
+    assert len(swept) == 1 and swept[0]["deadline_expired"] == 1
+    assert_leak_free(eng)
+
+
 def test_deadline_expired_in_flight_retires_midstream(params):
     """A live request whose deadline passes mid-decode retires with
     outcome 'deadline'; the tokens already streamed stand."""

@@ -47,9 +47,20 @@ import sys
 # round trips, engine-free radix tier transitions, hit-vs-cold parity
 # across forced demote/restore cycles exact+int8+cpu_mesh, per-block-
 # scale kernel oracles, lint host_pool scope fixtures, bench_compare
-# tiered families, disagg int8 shared-radix parity; 603 measured).
+# tiered families, disagg int8 shared-radix parity; 603 measured), 646
+# after PR 14 (concurrency/lifecycle lint passes: lock-order/donation-
+# safety/ledger-leak/mirror-drift fixtures + reintroduction tests +
+# --changed runner tests + the whole-repo-clean-under-10s subprocess
+# pin + the disagg flight robustness-counter regression + the review
+# fixes' regressions (while-condition dispatch, --relative --changed,
+# sweep-only flight records both loops, lock-order held-lock
+# acquire, mirror twin-side region deletion, ledger loop-depth
+# continue/break + while-test reserve, multi-item with
+# lock edges, locally-caught-raise release arcs, mirrored sweep-only
+# records, For/With
+# body-scan sink credit); 663 measured).
 # Raise as PRs add tests.
-FLOOR = 601
+FLOOR = 661
 
 # pytest progress lines: runs of pass/fail/error/skip/xfail/xpass markers
 # with an optional trailing percent — the same shape the ROADMAP one-liner

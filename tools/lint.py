@@ -10,8 +10,14 @@ Usage:
     python tools/lint.py                   # human output, baseline diff
     python tools/lint.py --json            # machine output
     python tools/lint.py --rules obs-guard host-sync
+    python tools/lint.py --changed         # only files differing vs HEAD
     python tools/lint.py --no-baseline     # report ALL findings
     python tools/lint.py --write-baseline  # grandfather current findings
+
+``--changed`` asks git for tracked files differing from HEAD (staged,
+unstaged, and untracked .py files under the linted roots) — the
+sub-second pre-commit loop. Without a git repo (or with git missing) it
+falls back to an explicit file list, erroring if none was given.
 
 Exit 0 when no findings beyond the committed baseline
 (``tools/lint_baseline.json`` — EMPTY by policy; see the lintlib
@@ -37,6 +43,37 @@ from tools import lintlib  # noqa: E402
 DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.json")
 
 
+def changed_files(root: str) -> "list[str] | None":
+    """Repo-relative .py files under the linted roots that differ from
+    HEAD (staged + unstaged + untracked), or None when git is unusable
+    (no repo, no binary) — the caller falls back to explicit args."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            # --relative: emit root-relative names (and scope out changes
+            # above root) — plain --name-only is toplevel-relative and
+            # never intersects discover_files() when root is a subdir.
+            ["git", "-C", root, "diff", "--relative", "--name-only",
+             "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+        extra = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines())
+    if extra.returncode == 0:
+        names |= set(extra.stdout.splitlines())
+    scoped = set(lintlib.discover_files(root))
+    return sorted(n for n in names if n in scoped)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("files", nargs="*",
@@ -47,6 +84,9 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", nargs="+", default=None,
                     metavar="RULE",
                     help="run only these passes")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files differing vs HEAD (git; "
+                         "falls back to explicit file args)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file to diff against")
     ap.add_argument("--no-baseline", action="store_true",
@@ -67,26 +107,49 @@ def main(argv=None) -> int:
             return 2
 
     root = args.root or _REPO
-    if args.files:
+
+    def _relative(names):
         # Scope filters match on repo-relative forward-slash paths; an
         # absolute or ./-prefixed spelling must not silently lint as
-        # out-of-scope-everything and report OK.
-        files = [
-            os.path.relpath(f, root) if os.path.isabs(f)
-            else os.path.normpath(f)
-            for f in args.files
+        # out-of-scope-everything (or intersect-to-nothing) and report
+        # OK.
+        return [
+            (os.path.relpath(f, root) if os.path.isabs(f)
+             else os.path.normpath(f)).replace(os.sep, "/")
+            for f in names
         ]
-        files = [f.replace(os.sep, "/") for f in files]
+
+    if args.changed:
+        files = changed_files(root)
+        if files is None:
+            if not args.files:
+                print("lint: --changed needs git (none usable here); "
+                      "pass explicit files instead", file=sys.stderr)
+                return 2
+            files = _relative(args.files)
+        elif args.files:
+            files = sorted(set(files) & set(_relative(args.files)))
+        if not files:
+            if args.as_json:
+                print(json.dumps({"files": 0, "findings": [],
+                                  "new": [], "baselined": 0}, indent=2))
+            else:
+                print("lint: 0 files changed vs HEAD, "
+                      "0 new finding(s) OK")
+            return 0
+    elif args.files:
+        files = _relative(args.files)
     else:
         files = lintlib.discover_files(root)
     findings = lintlib.run_passes(files, root=root, rules=args.rules)
 
     if args.write_baseline:
-        if args.rules or args.files:
+        if args.rules or args.files or args.changed:
             # A subset run sees a subset of findings; writing it would
             # silently erase every other rule's/file's baseline entries.
             print("lint: --write-baseline requires a full run "
-                  "(no --rules, no explicit files)", file=sys.stderr)
+                  "(no --rules, no --changed, no explicit files)",
+                  file=sys.stderr)
             return 2
         lintlib.write_baseline(args.baseline, findings)
         print(f"lint: wrote {len(findings)} finding(s) to "

@@ -30,6 +30,28 @@ never import jax, numpy, or anything from ``tree_attention_tpu``):
   lock, crash-path classes use re-entrant locks, and the signal-handler
   flush paths never emit telemetry (an emission inside a handler can
   re-enter the very lock the interrupted thread holds).
+- ``lock-order`` — per-class lock-acquisition graph over the threaded
+  serving/obs tiers: no blocking operation (unbounded ``.wait()`` /
+  ``.join()``, socket/HTTP reads, ``time.sleep``, engine dispatch)
+  while a lock is held — directly or through helper calls — and no
+  acquisition cycles between locks (the AB/BA deadlock class).
+- ``donation-safety`` — every jitted callable built with
+  ``donate_argnums`` has its donated bindings rebound before the next
+  read, and pool arrays shared between engines (declared with
+  ``# lint: donated-alias[a.cache, b.cache]``) are relayed to the other
+  owner after every donating dispatch — the missing-relay bug the CPU
+  backend silently masks by copying instead of donating.
+- ``ledger-leak`` — every allocator/host-pool/prefix-index *acquire*
+  (``alloc``/``reserve``/``match``-pin/``take_pending``/…) reaches a
+  slot-ledger store, a release API, or the caller (return) on every
+  exit arc of the acquiring function, so a new early return cannot
+  bypass the one-retire-path (PagedAttention's ledger, arXiv:2309.06180).
+- ``mirror-drift`` — the control-sweep regions of ``engine.py`` and
+  ``disagg.py`` bracketed by paired ``# lint: mirror[<tag>] begin/end``
+  markers must stay structurally identical (identifier renaming
+  tolerated, statement shape and SCREAMING_CASE constants not): a
+  sweep fix landing on one side only is a lint failure, not a drift
+  the token-parity gate cannot see.
 
 Suppression grammar (all passes): ``# lint: allow[<rule>] <reason>`` on
 the flagged line or the line above.  The reason is mandatory — an
@@ -83,10 +105,16 @@ class Finding:
 
 
 class Source:
-    """One parsed file: AST with parent links + the allow-comment map."""
+    """One parsed file: AST with parent links + the allow-comment map.
 
-    def __init__(self, path: str, text: str):
+    ``root`` is the repo root the file was read from — passes that need a
+    counterpart file (mirror-drift diffs engine.py against disagg.py)
+    resolve it relative to this root, so the runner's ``--root`` fake-repo
+    tests exercise them hermetically."""
+
+    def __init__(self, path: str, text: str, root: Optional[str] = None):
         self.path = path.replace(os.sep, "/")
+        self.root = root or REPO_ROOT
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
@@ -349,7 +377,8 @@ def _load_passes() -> None:
     # Imported lazily so ``import tools.lintlib`` stays cheap and cannot
     # cycle; each module registers via @lint_pass at import.
     from tools.lintlib import (  # noqa: F401
-        host_sync, locks, obs_guard, pallas, recompile,
+        donation, host_sync, ledger, lock_order, locks, mirror, obs_guard,
+        pallas, recompile,
     )
 
 
@@ -383,7 +412,7 @@ def run_passes(
         with open(os.path.join(root, rel), "r") as fh:
             text = fh.read()
         try:
-            src = Source(rel, text)
+            src = Source(rel, text, root=root)
         except SyntaxError as e:
             findings.append(Finding(
                 "parse", rel, e.lineno or 1, e.offset or 0,

@@ -1,0 +1,464 @@
+"""ledger-leak: every acquire reaches the one-retire-path on every arc.
+
+The paged pool's safety story (PagedAttention, arXiv:2309.06180) is a
+host-side ledger: blocks, reservations, radix pins, and host-tier rows
+are ACQUIRED at admission seams and released through exactly one retire
+path per owner.  The chaos benches verify the end state (allocators
+drain to zero), but a leak introduced on a *rare* exit arc — an early
+``return`` between an ``alloc()`` and its table store, a ``raise``
+while a matched path is still pinned — only shows up when that arc
+fires under load.  This pass makes the arc itself the failure:
+
+For every tracked acquire the bound name must, on EVERY path from the
+acquire to a function exit (``return`` / ``raise`` / ``continue`` /
+``break`` / fall-off-end), reach a kind-appropriate sink first:
+
+- ``alloc()`` / ``_alloc()`` / ``cancel_pending()`` / ``drop()``
+  (**block/row**): stored into a subscript/attribute ledger
+  (``self._host_table[slot, j] = bid``), passed as a direct call
+  argument (``free_demoted(bid)``, ``enqueue(row, …)``,
+  ``_Node(…, bid)``), or returned to the caller (ownership escapes).
+- ``<prefix>.match`` / ``.insert`` / ``.adopt`` (**pins** — the pinned
+  path element of the result tuple): stored into a ledger, passed to
+  ``release``/``adopt``, or returned.  Plain reads (``sum(1 for n in
+  nodes …)``) do NOT count — inspecting a pinned path is not releasing
+  it.
+- ``reserve(n)`` (**reservation**): must be *checked* (the ``if not
+  pool.reserve(n):`` idiom — a bare call discards the verdict and is
+  flagged outright); on the success arc the count must be stored,
+  ``unreserve``d, or returned.
+- ``take_pending()`` (**staged batch**): any use (the contract is only
+  that the batch cannot be dropped on an exit arc before processing).
+
+The dataflow understands the repo's absence guards — ``if row is
+None: …``, ``if nodes:``, ``while row is None and …: row = …`` — a
+name known absent on an arc needs no sink there.  ``assert`` is not an
+exit arc (a tripped ledger assert means the pool is already corrupt).
+
+Scope: ``serving/engine.py``, ``serving/disagg.py``,
+``serving/prefix_cache.py`` — the files that CALL the ledgers
+(``block_pool.py``/``host_pool.py`` are the ledgers; their internal
+free lists are their own tests' business).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lintlib import Finding, Source, dotted, emit, lint_pass
+
+RULE = "ledger-leak"
+
+_SCOPE = (
+    "tree_attention_tpu/serving/engine.py",
+    "tree_attention_tpu/serving/disagg.py",
+    "tree_attention_tpu/serving/prefix_cache.py",
+)
+
+#: method name -> (kind, index into a tuple-unpack result holding the
+#: resource, or None when the whole result is it).
+_ACQUIRES: Dict[str, Tuple[str, Optional[int]]] = {
+    "alloc": ("block", None),
+    "_alloc": ("block", None),
+    "cancel_pending": ("block", None),
+    "drop": ("block", None),
+    "take_pending": ("staged", None),
+    "match": ("pins", 1),
+    "insert": ("pins", 0),
+    "adopt": ("pins", 0),
+}
+#: Acquire names that only count on a prefix-index receiver (``match``
+#: etc. are common verbs; ``self._trees[n].match`` in the router is an
+#: int score, not a pin).
+_PREFIX_ONLY = {"match", "insert", "adopt"}
+_PIN_SINK_CALLS = {"release", "adopt"}
+
+
+def _acquire_of(call: ast.Call) -> Optional[Tuple[str, Optional[int]]]:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    name = call.func.attr
+    if name not in _ACQUIRES:
+        return None
+    if name in _PREFIX_ONLY:
+        recv = (dotted(call.func.value) or "").lower()
+        if "prefix" not in recv:
+            return None  # router trees score matches; they don't pin
+    return _ACQUIRES[name]
+
+
+class _Pending:
+    __slots__ = ("kind", "node", "what", "depth")
+
+    def __init__(self, kind: str, node: ast.AST, what: str,
+                 depth: int = 0):
+        self.kind = kind
+        self.node = node
+        self.what = what
+        # Loop-nesting depth at the acquire site: ``continue``/``break``
+        # leak only resources acquired inside the loop they exit — a
+        # pre-loop acquire is still live after the loop.
+        self.depth = depth
+
+
+def _guards(test: ast.AST) -> List[Tuple[str, bool]]:
+    """(name, present_when_true) facts ``test`` establishes.
+
+    ``x is None`` -> (x, False); ``x is not None`` / bare ``x`` ->
+    (x, True); ``not x`` -> (x, False); ``and`` conjoins (all facts hold
+    in the true branch)."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        out: List[Tuple[str, bool]] = []
+        for v in test.values:
+            out.extend(_guards(v))
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return [(n, not p) for n, p in _guards(test.operand)]
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None \
+            and isinstance(test.left, ast.Name):
+        if isinstance(test.ops[0], ast.Is):
+            return [(test.left.id, False)]
+        if isinstance(test.ops[0], ast.IsNot):
+            return [(test.left.id, True)]
+    if isinstance(test, ast.Name):
+        return [(test.id, True)]
+    return []
+
+
+def _reserve_in_test(test: ast.AST) -> Optional[Tuple[ast.Call, bool]]:
+    """A ``[not] X.reserve(...)`` at the top of an If/While test:
+    (call, success_in_body)."""
+    neg = False
+    t = test
+    if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not):
+        neg, t = True, t.operand
+    if (isinstance(t, ast.Call) and isinstance(t.func, ast.Attribute)
+            and t.func.attr == "reserve"):
+        return t, not neg
+    return None
+
+
+def _names_in(expr: Optional[ast.AST]) -> Set[str]:
+    if expr is None:
+        return set()
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)}
+
+
+def _direct_call_args(st: ast.AST) -> List[Tuple[str, str]]:
+    """(arg_name, callee_attr_or_func_name) for every direct Name arg."""
+    out = []
+    for node in ast.walk(st):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = (node.func.attr if isinstance(node.func, ast.Attribute)
+                  else node.func.id if isinstance(node.func, ast.Name)
+                  else "")
+        for a in node.args:
+            if isinstance(a, ast.Name):
+                out.append((a.id, callee))
+        for kw in node.keywords:
+            if isinstance(kw.value, ast.Name):
+                out.append((kw.value.id, callee))
+    return out
+
+
+def _store_rhs_names(st: ast.stmt) -> Set[str]:
+    """Names read on the RHS of a store whose target is a ledger-shaped
+    container (subscript or attribute)."""
+    if isinstance(st, ast.Assign):
+        if any(isinstance(t, (ast.Subscript, ast.Attribute))
+               for t in st.targets):
+            return _names_in(st.value)
+    if isinstance(st, ast.AugAssign) \
+            and isinstance(st.target, (ast.Subscript, ast.Attribute)):
+        return _names_in(st.value)
+    return set()
+
+
+class _Flow:
+    def __init__(self, src: Source, fn: ast.FunctionDef,
+                 findings: List[Finding]):
+        self.src = src
+        self.fn = fn
+        self.findings = findings
+        self.pending: Dict[str, _Pending] = {}
+        self.terminated = False
+        self.depth = 0  # loop-nesting depth of the current walk point
+        # One collector per enclosing handler-bearing try: the pendings
+        # live at each caught raise point, fed into the handler branches
+        # (a locally-caught raise is the HANDLER's arc, not an exit).
+        self.try_stack: List[Dict[str, _Pending]] = []
+
+    # -- sinks -------------------------------------------------------------
+
+    def _apply_sinks(self, st: ast.AST) -> None:
+        if not self.pending:
+            return
+        call_args = _direct_call_args(st)
+        stores = _store_rhs_names(st)
+        ret_names = (_names_in(st.value)
+                     if isinstance(st, ast.Return) else set())
+        all_reads = _names_in(st)
+        for name in list(self.pending):
+            p = self.pending[name]
+            sunk = False
+            if p.kind == "staged":
+                sunk = name in all_reads
+            elif p.kind == "pins":
+                sunk = (name in stores or name in ret_names
+                        or any(a == name and c in _PIN_SINK_CALLS
+                               for a, c in call_args))
+            else:  # block / reserve
+                sunk = (name in stores or name in ret_names
+                        or any(a == name for a, c in call_args))
+            if sunk:
+                del self.pending[name]
+
+    def _leak(self, where: ast.stmt, arc: str) -> None:
+        for name, p in sorted(self.pending.items()):
+            emit(self.findings, self.src, RULE, where,
+                 f"{self.fn.name}: {p.kind} '{name}' (acquired via "
+                 f".{p.what}() at line {p.node.lineno}) leaks on this "
+                 f"{arc} — store it in a ledger, release it, or return "
+                 f"it before leaving")
+        self.pending.clear()
+
+    # -- acquires ----------------------------------------------------------
+
+    def _acquire_from_assign(self, st: ast.Assign) -> None:
+        if not isinstance(st.value, ast.Call):
+            return
+        acq = _acquire_of(st.value)
+        if acq is None:
+            return
+        kind, idx = acq
+        what = st.value.func.attr  # type: ignore[union-attr]
+        for t in st.targets:
+            if isinstance(t, ast.Name):
+                if idx is None or not isinstance(t, ast.Tuple):
+                    self.pending[t.id] = _Pending(kind, st.value, what,
+                                                  self.depth)
+            elif isinstance(t, ast.Tuple) and idx is not None \
+                    and idx < len(t.elts) \
+                    and isinstance(t.elts[idx], ast.Name):
+                self.pending[t.elts[idx].id] = _Pending(
+                    kind, st.value, what, self.depth
+                )
+
+    def _unchecked_reserve(self, st: ast.stmt) -> None:
+        """A reserve() whose boolean verdict is discarded."""
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "reserve"):
+                emit(self.findings, self.src, RULE, call,
+                     f"{self.fn.name}: unchecked {dotted(call.func)}"
+                     f"(...) — a failed reservation must defer the "
+                     f"admission, not vanish into an ignored bool")
+
+    # -- walk --------------------------------------------------------------
+
+    def block(self, stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            if self.terminated:
+                return
+            self.statement(st)
+
+    def _branch(self, stmts: Sequence[ast.stmt],
+                drop: Set[str],
+                add: Optional[Tuple[str, _Pending]] = None,
+                extra: Optional[Dict[str, _Pending]] = None,
+                ) -> Tuple[Dict[str, _Pending], bool]:
+        saved, saved_term = self.pending, self.terminated
+        self.pending = {k: v for k, v in saved.items() if k not in drop}
+        if extra:
+            self.pending.update(extra)
+        if add is not None:
+            self.pending[add[0]] = add[1]
+        self.terminated = False
+        self.block(stmts)
+        out = (self.pending, self.terminated)
+        self.pending, self.terminated = saved, saved_term
+        return out
+
+    def statement(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Assert)):
+            return
+        if isinstance(st, ast.Return):
+            self._apply_sinks(st)
+            if self.pending:
+                self._leak(st, "return")
+            self.terminated = True
+            return
+        if isinstance(st, ast.Raise):
+            if self.try_stack:
+                # A local handler may catch this and release — defer
+                # the verdict: the pendings live HERE feed the handler
+                # branches, which flag their own exit arcs.
+                self.try_stack[-1].update(self.pending)
+            elif self.pending:
+                self._leak(st, "raise")
+            self.terminated = True
+            return
+        if isinstance(st, (ast.Continue, ast.Break)):
+            # Only resources acquired INSIDE the loop being exited leak
+            # here — a pre-loop acquire survives the loop and its sink
+            # after the loop still counts.
+            inner = {n: p for n, p in self.pending.items()
+                     if p.depth >= self.depth}
+            if inner:
+                saved = self.pending
+                self.pending = inner
+                self._leak(st, "loop exit")
+                self.pending = {n: p for n, p in saved.items()
+                                if n not in inner}
+            self.terminated = True
+            return
+        if isinstance(st, ast.If):
+            facts = _guards(st.test)
+            resv = _reserve_in_test(st.test)
+            body_drop = {n for n, present in facts if not present}
+            else_drop = {n for n, present in facts if present}
+            body_add = else_add = None
+            if resv is not None:
+                call, success_in_body = resv
+                arg = (call.args[0].id if call.args
+                       and isinstance(call.args[0], ast.Name) else None)
+                if arg is not None:
+                    pend = _Pending("reserve", call, "reserve",
+                                    self.depth)
+                    if success_in_body:
+                        body_add = (arg, pend)
+                    else:
+                        else_add = (arg, pend)
+            b_pend, b_term = self._branch(st.body, body_drop, body_add)
+            e_pend, e_term = self._branch(st.orelse, else_drop, else_add)
+            merged: Dict[str, _Pending] = {}
+            if not b_term:
+                merged.update(b_pend)
+            if not e_term:
+                merged.update(e_pend)
+            if b_term and e_term:
+                self.pending = {}
+                self.terminated = True
+                return
+            self.pending = merged
+            return
+        if isinstance(st, ast.While):
+            facts = _guards(st.test)
+            resv = _reserve_in_test(st.test)
+            body_drop = {n for n, present in facts if not present}
+            body_add = after_add = None
+            if resv is not None:
+                call, success_in_body = resv
+                arg = (call.args[0].id if call.args
+                       and isinstance(call.args[0], ast.Name) else None)
+                if arg is not None:
+                    if success_in_body:
+                        # ``while pool.reserve(n):`` — held inside each
+                        # iteration (acquired at the loop's depth).
+                        body_add = (arg, _Pending("reserve", call,
+                                                  "reserve",
+                                                  self.depth + 1))
+                    else:
+                        # ``while not pool.reserve(n): evict()`` — the
+                        # loop exits exactly when the reservation took;
+                        # it is pending AFTER the loop.
+                        after_add = (arg, _Pending("reserve", call,
+                                                   "reserve",
+                                                   self.depth))
+            self.depth += 1
+            b_pend, b_term = self._branch(st.body, body_drop, body_add)
+            self.depth -= 1
+            # fall-through keeps the entry pendings plus anything the
+            # body left unsunk (conservative).
+            if not b_term:
+                self.pending.update(b_pend)
+            if after_add is not None:
+                self.pending[after_add[0]] = after_add[1]
+            self.block(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            # Only the ITER expression may sink here — the body is
+            # branch-analyzed below, and crediting a release buried in
+            # it up front would accept conditional (or zero-iteration)
+            # release arcs unconditionally.
+            self._apply_sinks(st.iter)
+            self.depth += 1
+            b_pend, b_term = self._branch(st.body, set())
+            self.depth -= 1
+            if not b_term:
+                self.pending.update(b_pend)
+            self.block(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            # Context expressions only — the body walks inline below
+            # and applies its own sinks statement by statement.
+            for item in st.items:
+                self._apply_sinks(item.context_expr)
+            self.block(st.body)
+            return
+        if isinstance(st, ast.Try):
+            # The body walks as a branch: a Raise inside it that a
+            # handler catches must not mark the WHOLE function
+            # terminated — the statements after the try are live and
+            # an alloc-then-leak there is exactly the arc this pass
+            # exists for.
+            if st.handlers:
+                self.try_stack.append({})
+            b_pend, b_term = self._branch(st.body, set())
+            caught = self.try_stack.pop() if st.handlers else {}
+            # Handler branches see the entry pendings PLUS whatever was
+            # live at each caught raise point (union — conservative).
+            h_res = [self._branch(h.body, set(), extra=caught)
+                     for h in st.handlers]
+            if b_term and all(t for _, t in h_res):
+                # Every arc through the try terminates (a try/finally
+                # whose body terminates has no catching arc at all);
+                # finally still runs with the entry pendings live.
+                self.block(st.finalbody)
+                self.pending = {}
+                self.terminated = True
+                return
+            merged: Dict[str, _Pending] = {}
+            if not b_term:
+                merged.update(b_pend)
+            else:
+                # The body terminated but a handler catches:
+                # acquisitions made BEFORE the try stay live on the
+                # caught arc.
+                merged.update(self.pending)
+            for h_pend, h_term in h_res:
+                if not h_term:
+                    merged.update(h_pend)
+            self.pending = merged
+            self.block(st.orelse)
+            self.block(st.finalbody)
+            return
+        # plain statement: sinks first, then new acquires
+        self._unchecked_reserve(st)
+        self._apply_sinks(st)
+        if isinstance(st, ast.Assign):
+            self._acquire_from_assign(st)
+
+    def run(self) -> None:
+        self.block(self.fn.body)
+        if not self.terminated and self.pending:
+            self._leak(self.fn.body[-1], "fall-through")
+
+
+@lint_pass(RULE)
+def check(src: Source) -> List[Finding]:
+    if src.path not in _SCOPE:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            _Flow(src, node, findings).run()
+    return findings

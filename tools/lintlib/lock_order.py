@@ -1,0 +1,343 @@
+"""lock-order: no blocking while locked, no lock-acquisition cycles.
+
+The serving plane is four threaded tiers — engine mailboxes, ingress
+handler threads, the router/fleet pair, the disagg control seams — plus
+the obs instruments every one of them emits through. Each tier owns one
+or two locks and the deadlock rules live only in review convention:
+
+- **no blocking under a lock** — a ``with self._lock:`` body (or any
+  helper reached from one, threaded through same-file calls) must not
+  perform an unbounded blocking operation: ``.wait()`` / ``.join()`` /
+  queue ``.get()`` with no timeout, ``time.sleep``, socket/HTTP I/O
+  (``urlopen`` / ``.getresponse`` / ``.recv`` / ``.accept`` /
+  ``create_connection``), or an engine dispatch (``.serve(...)``).  A
+  handler thread parked inside the lock starves every other handler AND
+  the engine seam behind it; the fleet supervisor's recovery path is the
+  ONE deliberate exception and carries per-site ``allow[]`` reasons.
+  Waiting on the held lock's own condition (``self._lock.wait(t)``)
+  releases it by definition and is exempt.
+- **no acquisition cycles** — an edge A→B is recorded whenever lock B
+  is acquired (directly, via a helper, or via a same-file class whose
+  method takes its own lock) while A is held.  A cycle in that graph is
+  the AB/BA deadlock: thread 1 holds A wanting B, thread 2 holds B
+  wanting A.  The current design is acyclic by construction (state
+  locks nest under the fleet's ``_op_lock``, never the reverse); this
+  pass pins it.
+
+Analysis is per-file and name-based (the `locks.py` signal-path trick):
+a call resolves to every same-file function/method sharing its last
+name component. Cross-file lock coupling does not exist in the current
+tier design — handler threads reach the engine only through the three
+mailbox seams — and the blocking rule is what keeps new code from
+introducing it invisibly.
+
+Scope: ``tree_attention_tpu/serving/`` and ``tree_attention_tpu/obs/``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lintlib import Finding, Source, dotted, emit, lint_pass, parent
+
+RULE = "lock-order"
+
+#: Blocking calls by dotted-name suffix (zero-arg methods that park the
+#: calling thread until an external event).
+_BLOCKING_NO_ARG_METHODS = {"wait", "join", "get", "acquire"}
+#: Blocking regardless of arguments (network / scheduling primitives).
+_BLOCKING_ALWAYS = {
+    "time.sleep", "urlopen", "socket.create_connection",
+}
+_BLOCKING_ALWAYS_METHODS = {"getresponse", "recv", "accept", "serve"}
+
+
+def _in_scope(path: str) -> bool:
+    return (path.startswith("tree_attention_tpu/serving/")
+            or path.startswith("tree_attention_tpu/obs/"))
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Names of ``self._*lock*`` attributes assigned in ``__init__``."""
+    out: Set[str] = set()
+    for m in cls.body:
+        if isinstance(m, ast.FunctionDef) and m.name == "__init__":
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    d = dotted(t)
+                    if (d and d.startswith("self._")
+                            and d.count(".") == 1
+                            and "lock" in d.lower()):
+                        out.add(d.split(".", 1)[1])
+    return out
+
+
+def _held_locks(node: ast.AST, lock_names: Set[str]) -> List[str]:
+    """Class-local locks lexically held at ``node`` (innermost last)."""
+    held: List[str] = []
+    p = parent(node)
+    while p is not None:
+        if isinstance(p, (ast.With, ast.AsyncWith)):
+            for item in p.items:
+                d = dotted(item.context_expr) or ""
+                if d.startswith("self.") and d.split(".", 1)[1] in lock_names:
+                    held.append(d.split(".", 1)[1])
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        p = parent(p)
+    return list(reversed(held))
+
+
+def _blocking_reason(call: ast.Call, held: List[str]) -> Optional[str]:
+    """Why ``call`` blocks, or None. ``held`` names exempt waiting on the
+    held lock's own condition variable (wait() releases it)."""
+    d = dotted(call.func) or ""
+    if d in _BLOCKING_ALWAYS or d.split(".")[-1] == "urlopen":
+        return f"{d}() is blocking I/O"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    name = call.func.attr
+    if name in _BLOCKING_ALWAYS_METHODS:
+        return f".{name}(...) blocks on I/O or the engine loop"
+    if name in _BLOCKING_NO_ARG_METHODS and not call.args \
+            and not call.keywords:
+        recv = dotted(call.func.value) or ""
+        if (name == "wait" and recv.startswith("self.")
+                and recv.split(".", 1)[1] in held):
+            # Only wait() RELEASES the held lock while parked; a no-arg
+            # .acquire()/.join()/.get() on it is the self-deadlock case.
+            return None
+        return (f"{recv or '<expr>'}.{name}() has no timeout — it can "
+                f"park this thread forever")
+    return None
+
+
+class _FileModel:
+    """Per-file call/lock model: functions by last-name component, each
+    with its direct lock acquisitions, blocking calls, and call sites —
+    every one tagged with the locks lexically held there."""
+
+    def __init__(self, src: Source):
+        self.src = src
+        # qual -> (fn node, owner lock names)
+        self.functions: Dict[str, Tuple[ast.FunctionDef, Set[str]]] = {}
+        self.by_name: Dict[str, List[str]] = {}
+        # attr name -> class name for `self.x = ClassName(...)` in this
+        # file (cross-class edges: router embedded in a supervisor, etc.)
+        self.attr_class: Dict[str, str] = {}
+        classes = [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.ClassDef)]
+        class_names = {c.name for c in classes}
+        for cls in classes:
+            locks = _lock_attrs(cls)
+            for m in cls.body:
+                if isinstance(m, ast.FunctionDef):
+                    qual = f"{cls.name}.{m.name}"
+                    self.functions[qual] = (m, locks)
+                    self.by_name.setdefault(m.name, []).append(qual)
+            for m in cls.body:
+                if not (isinstance(m, ast.FunctionDef)
+                        and m.name == "__init__"):
+                    continue
+                for node in ast.walk(m):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        cn = (dotted(node.value.func) or "").split(".")[-1]
+                        if cn in class_names:
+                            for t in node.targets:
+                                d = dotted(t)
+                                if d and d.startswith("self.") \
+                                        and d.count(".") == 1:
+                                    self.attr_class[d.split(".")[1]] = cn
+        for node in src.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = (node, set())
+                self.by_name.setdefault(node.name, []).append(node.name)
+
+    def owner(self, qual: str) -> str:
+        return qual.split(".")[0] if "." in qual else ""
+
+    def lock_node(self, qual: str, lock: str) -> str:
+        """Graph node id for a lock: ``Class._lock`` (file-local)."""
+        return f"{self.owner(qual)}.{lock}"
+
+    def direct_acquires(self, qual: str) -> List[Tuple[str, ast.With]]:
+        fn, locks = self.functions[qual]
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    d = dotted(item.context_expr) or ""
+                    if d.startswith("self.") \
+                            and d.split(".", 1)[1] in locks:
+                        out.append((d.split(".", 1)[1], node))
+        return out
+
+    def resolve(self, call: ast.Call, qual: str) -> List[str]:
+        """Same-file targets of ``call``: self-methods by name, plus
+        ``self.<attr>.<m>`` through a known embedded class."""
+        if not isinstance(call.func, ast.Attribute):
+            d = dotted(call.func)
+            if d and d in self.by_name:
+                return [q for q in self.by_name[d] if "." not in q]
+            return []
+        name = call.func.attr
+        recv = dotted(call.func.value) or ""
+        owner = self.owner(qual)
+        if recv == "self" and owner:
+            return [q for q in self.by_name.get(name, ())
+                    if self.owner(q) == owner]
+        if recv.startswith("self.") and recv.count(".") == 1:
+            cls = self.attr_class.get(recv.split(".")[1])
+            if cls is None:
+                return []  # a non-class attribute (Thread, Popen, ...)
+            return [q for q in self.by_name.get(name, ())
+                    if self.owner(q) == cls]
+        if not isinstance(call.func.value, ast.Name):
+            return []
+        # Last resort (the locks.py name trick): a bare-variable receiver
+        # resolves to EVERY same-file method of that name — the
+        # supervisor's duck-typed `rep.await_drained()` may be either
+        # replica class, and the analysis unions their behaviors.
+        return [q for q in self.by_name.get(name, ()) if "." in q]
+
+
+def _transitive(model: _FileModel) -> Tuple[
+    Dict[str, Set[str]], Dict[str, List[Tuple[ast.Call, str, str]]]
+]:
+    """Fixpoint over the same-file call graph.
+
+    Returns ``acquired_inside[qual]`` — lock nodes a call to ``qual``
+    may take — and ``blocking_inside[qual]`` — (call, reason, where)
+    blocking operations a call to ``qual`` may reach (``where`` names
+    the function containing the raw call, for the message)."""
+    acquired: Dict[str, Set[str]] = {q: set() for q in model.functions}
+    blocking: Dict[str, List[Tuple[ast.Call, str, str]]] = {
+        q: [] for q in model.functions
+    }
+    for qual, (fn, locks) in model.functions.items():
+        for lock, _ in model.direct_acquires(qual):
+            acquired[qual].add(model.lock_node(qual, lock))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                held = _held_locks(node, locks)
+                reason = _blocking_reason(node, held)
+                if reason is not None:
+                    blocking[qual].append((node, reason, qual))
+    for _ in range(len(model.functions) + 1):
+        changed = False
+        for qual, (fn, locks) in model.functions.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for tgt in model.resolve(node, qual):
+                    if tgt == qual:
+                        continue
+                    if not acquired[tgt] <= acquired[qual]:
+                        acquired[qual] |= acquired[tgt]
+                        changed = True
+                    for sub, reason, where in blocking[tgt]:
+                        entry = (node, reason, where)
+                        if entry not in blocking[qual] \
+                                and len(blocking[qual]) < 64:
+                            blocking[qual].append(entry)
+                            changed = True
+        if not changed:
+            break
+    return acquired, blocking
+
+
+@lint_pass(RULE)
+def check(src: Source) -> List[Finding]:
+    if not _in_scope(src.path):
+        return []
+    findings: List[Finding] = []
+    model = _FileModel(src)
+    acquired, blocking = _transitive(model)
+
+    # -- blocking-while-locked + the acquisition-edge sweep ----------------
+    edges: Dict[Tuple[str, str], ast.AST] = {}
+    for qual, (fn, locks) in model.functions.items():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            held = _held_locks(node, locks)
+            if not held:
+                continue
+            held_nodes = [model.lock_node(qual, h) for h in held]
+            reason = _blocking_reason(node, held)
+            if reason is not None:
+                emit(findings, src, RULE, node,
+                     f"{qual} blocks while holding {held_nodes[-1]}: "
+                     f"{reason}")
+            for tgt in model.resolve(node, qual):
+                if tgt == qual:
+                    continue
+                for inner in acquired[tgt]:
+                    for h in held_nodes:
+                        if inner != h:
+                            edges.setdefault((h, inner), node)
+                for sub, sreason, where in blocking[tgt]:
+                    emit(findings, src, RULE, node,
+                         f"{qual} holds {held_nodes[-1]} across a call "
+                         f"into {where}, which blocks: {sreason}")
+        # Direct nesting: `with self._a:` containing `with self._b:`.
+        for lock, wnode in model.direct_acquires(qual):
+            outer = _held_locks(wnode, locks)
+            # A multi-item `with self._a, self._b:` acquires left to
+            # right — earlier items are held when a later one acquires,
+            # exactly like the nested spelling (_held_locks only walks
+            # ancestors, so same-With siblings need collecting here).
+            for item in wnode.items:
+                d = dotted(item.context_expr) or ""
+                nm = (d.split(".", 1)[1]
+                      if d.startswith("self.") else None)
+                if nm == lock:
+                    break
+                if nm is not None and nm in locks:
+                    outer.append(nm)
+            for h in outer:
+                if h != lock:
+                    edges.setdefault(
+                        (model.lock_node(qual, h),
+                         model.lock_node(qual, lock)),
+                        wnode,
+                    )
+
+    # -- cycle detection over the acquisition graph ------------------------
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reaches(start: str, goal: str) -> bool:
+        seen, work = set(), [start]
+        while work:
+            n = work.pop()
+            if n == goal:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            work.extend(graph.get(n, ()))
+        return False
+
+    for (a, b), where in sorted(edges.items(),
+                                key=lambda kv: kv[1].lineno):
+        if reaches(b, a):
+            emit(findings, src, RULE, where,
+                 f"lock-order cycle: {a} is held while acquiring {b}, "
+                 f"but {b} can also be held while acquiring {a} — the "
+                 f"AB/BA deadlock")
+    # The name-union resolution can derive one blocking fact through two
+    # call chains; identical findings collapse to one.
+    seen: Set[Tuple[int, int, str]] = set()
+    out: List[Finding] = []
+    for f in findings:
+        k = (f.line, f.col, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

@@ -1,0 +1,221 @@
+"""mirror-drift: the disagg serve loop tracks the engine's, by machine.
+
+``DisaggServer.serve`` deliberately MIRRORS ``SlotServer.serve``'s
+control sweep (DistServe's phase split, arXiv:2401.09670, specialized —
+no decode rows in the prefill tick, no chunk rows in the decode tick)
+instead of sharing helpers with the fused hot loop.  That was the right
+call for the tick loop's shape — and it created the drift class the
+token-parity gate cannot see: a fix to cancel-carry TTL, deadline
+ordering, or drain-shed semantics landing in one file only changes
+*control-plane* behavior (race outcomes), not token streams.
+
+This pass makes the mirroring a checked contract.  Both files bracket
+their mirrored regions with paired markers::
+
+    # lint: mirror[cancel-carry] begin
+    ...statements...
+    # lint: mirror[cancel-carry] end
+
+and the pass structurally diffs each tag's region between the two
+files after normalization:
+
+- identifier RENAMING is tolerated — ``self._validate`` vs
+  ``pf._validate`` compare equal (non-constant names map to positional
+  placeholders by first occurrence, consistently across the region);
+- SCREAMING_CASE names stay literal — swapping ``OUTCOME_SHED`` for
+  ``OUTCOME_CANCELLED`` is drift, not renaming;
+- statement SHAPE and constants are compared exactly — adding, removing,
+  or reordering a statement on one side fails, whichever side it landed
+  on (both files run the comparison, so ``--changed`` runs linting only
+  the edited file still catch it).
+
+A tag present in one file but not the other, or an unpaired
+``begin``/``end``, is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.lintlib import Finding, Source, emit, lint_pass
+
+RULE = "mirror-drift"
+
+_PAIR = {
+    "tree_attention_tpu/serving/engine.py":
+        "tree_attention_tpu/serving/disagg.py",
+    "tree_attention_tpu/serving/disagg.py":
+        "tree_attention_tpu/serving/engine.py",
+}
+
+_MARK_RE = re.compile(r"#\s*lint:\s*mirror\[([a-z0-9_-]+)\]\s*(begin|end)")
+_SCREAMING_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+def regions(src: Source) -> Tuple[Dict[str, Tuple[int, int]], List[str]]:
+    """tag -> (begin_line, end_line); plus marker-grammar errors."""
+    out: Dict[str, Tuple[int, int]] = {}
+    open_tags: Dict[str, int] = {}
+    errors: List[str] = []
+    for i, ln in enumerate(src.lines, 1):
+        m = _MARK_RE.search(ln)
+        if not m:
+            continue
+        tag, which = m.group(1), m.group(2)
+        if which == "begin":
+            if tag in out or tag in open_tags:
+                errors.append(f"line {i}: duplicate mirror[{tag}] begin")
+            else:
+                open_tags[tag] = i
+        else:
+            if tag not in open_tags:
+                errors.append(f"line {i}: mirror[{tag}] end without begin")
+            else:
+                out[tag] = (open_tags.pop(tag), i)
+    for tag, i in open_tags.items():
+        errors.append(f"line {i}: mirror[{tag}] begin without end")
+    return out, errors
+
+
+def _region_stmts(src: Source, begin: int, end: int) -> List[ast.stmt]:
+    """Maximal statements fully inside the (begin, end) line range."""
+    out: List[ast.stmt] = []
+
+    def collect(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            lo = getattr(child, "lineno", None)
+            hi = getattr(child, "end_lineno", None)
+            if lo is None:
+                continue
+            if isinstance(child, ast.stmt) and lo > begin \
+                    and (hi or lo) < end:
+                out.append(child)
+            elif (hi or lo) >= begin and lo <= end:
+                collect(child)
+
+    collect(src.tree)
+    # A statement nested in a collected one was reached first — iteration
+    # order guarantees maximality; sort by position for stable compare.
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out
+
+
+class _Normalize(ast.NodeTransformer):
+    """Positional renaming of non-constant identifiers, region-wide."""
+
+    def __init__(self):
+        self.map: Dict[str, str] = {}
+
+    def _ph(self, name: str) -> str:
+        if _SCREAMING_RE.match(name):
+            return name
+        if name not in self.map:
+            self.map[name] = f"v{len(self.map)}"
+        return self.map[name]
+
+    def visit_Name(self, node: ast.Name):
+        return ast.copy_location(
+            ast.Name(id=self._ph(node.id), ctx=node.ctx), node
+        )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        self.generic_visit(node)
+        if node.name:
+            node.name = self._ph(node.name)
+        return node
+
+    def visit_arg(self, node: ast.arg):
+        node.arg = self._ph(node.arg)
+        return node
+
+
+def normalize_region(stmts: List[ast.stmt]) -> List[str]:
+    import copy
+
+    norm = _Normalize()
+    out = []
+    for st in stmts:
+        # Transform a deep copy — the statements belong to the Source's
+        # shared tree, and every other pass still has to analyze the
+        # original identifiers after this one runs.
+        mod = ast.Module(body=[copy.deepcopy(st)], type_ignores=[])
+        mod = norm.visit(ast.fix_missing_locations(mod))
+        out.append(ast.dump(mod, annotate_fields=False,
+                            include_attributes=False))
+    return out
+
+
+def compare_sources(a: Source, b: Source) -> List[Tuple[str, int, str]]:
+    """Drift between two marked sources: (tag, line_in_a, message)."""
+    out: List[Tuple[str, int, str]] = []
+    regs_a, errs_a = regions(a)
+    regs_b, _ = regions(b)
+    for e in errs_a:
+        out.append(("<markers>", int(e.split(":")[0].split()[-1]), e))
+    for tag in sorted(regs_a):
+        if tag not in regs_b:
+            ba, _ = regs_a[tag]
+            out.append((tag, ba,
+                        f"mirror[{tag}] exists here but not in "
+                        f"{b.path} — the mirrored sweep lost its twin"))
+            continue
+        sa = _region_stmts(a, *regs_a[tag])
+        sb = _region_stmts(b, *regs_b[tag])
+        na, nb = normalize_region(sa), normalize_region(sb)
+        if len(na) != len(nb):
+            ba, _ = regs_a[tag]
+            out.append((tag, ba,
+                        f"mirror[{tag}] has {len(na)} statement(s) here "
+                        f"vs {len(nb)} in {b.path} — a sweep edit "
+                        f"landed on one side only"))
+            continue
+        for i, (da, db) in enumerate(zip(na, nb)):
+            if da != db:
+                out.append((
+                    tag, sa[i].lineno,
+                    f"mirror[{tag}] statement {i + 1} diverges from "
+                    f"{b.path} (identifier renames are tolerated; "
+                    f"shape and constants are not) — port the fix to "
+                    f"both sides",
+                ))
+                break
+    for tag in sorted(set(regs_b) - set(regs_a)):
+        # Deleting a marked region from THIS file must fail a --changed
+        # run that lints only this file — the twin's marker is the
+        # witness (the docstring's both-sides guarantee).
+        out.append((tag, 1,
+                    f"mirror[{tag}] exists in {b.path} (line "
+                    f"{regs_b[tag][0]}) but not here — the mirrored "
+                    f"sweep lost its twin"))
+    return out
+
+
+@lint_pass(RULE)
+def check(src: Source) -> List[Finding]:
+    other_rel = _PAIR.get(src.path)
+    if other_rel is None:
+        return []
+    other_path = os.path.join(src.root, other_rel.replace("/", os.sep))
+    try:
+        with open(other_path, "r") as fh:
+            other = Source(other_rel, fh.read(), root=src.root)
+    except (OSError, SyntaxError):
+        # The counterpart is unreadable in this tree (fixture snippets,
+        # partial checkouts): marker grammar is still checked locally.
+        regs, errs = regions(src)
+        findings: List[Finding] = []
+        for e in errs:
+            emit(findings, src, RULE, src.tree, f"mirror marker: {e}")
+        return findings
+    findings: List[Finding] = []
+    for tag, line, message in compare_sources(src, other):
+        # Route through emit for the allow[] grammar: a position-bearing
+        # carrier node stands in for the marker line.
+        node = ast.Pass()
+        node.lineno = line
+        node.col_offset = 0
+        emit(findings, src, RULE, node, message)
+    return findings

@@ -481,6 +481,11 @@ class DisaggServer:
         drift (cancel/deadline race semantics). Grep anchor:
         engine.py's serve() carries the same section comments."""
         pf, dc = self.prefill, self.decode
+        # The two workers' caches are views of ONE donated pool array
+        # set: a dispatch through either consumes the other's view until
+        # _relay_pool rebinds it (machine-checked by the donation-safety
+        # lint pass through this declaration).
+        # lint: donated-alias[pf.cache, dc.cache]
         live = isinstance(requests, RequestSource)
         if live:
             source: RequestSource = requests
@@ -537,9 +542,16 @@ class DisaggServer:
                 pf._tick_prefix_hits = 0
                 pf._tick_prefix_reused = 0
                 pf._tick_restored = 0
+                # Robustness-arc counters mirror the fused engine's (the
+                # prefill worker holds the pair's sweep stats; the flight
+                # record below surfaces them like SlotServer.serve does).
+                pf._tick_cancelled = 0
+                pf._tick_deadline = 0
+                pf._tick_shed = 0
 
                 # Ingest newly visible requests (live invalids finish
                 # with outcome 'error'; static traces validated up front).
+                # lint: mirror[ingest] begin
                 for r in source.poll(tick):
                     vis = r.visible_at if r.visible_at is not None else now
                     try:
@@ -555,6 +567,7 @@ class DisaggServer:
                     if obs.TRACER.active:
                         obs.instant("request_queued", cat="serving",
                                     args={"rid": r.uid, "tick": tick})
+                # lint: mirror[ingest] end
 
                 # Control sweep — the fused engine's ordering (cancel
                 # beats deadline beats drain-shed), applied across BOTH
@@ -564,20 +577,25 @@ class DisaggServer:
                 cancels, draining = self._take_control()
                 cancels |= set(cancel_carry)
                 if cancels:
+                    # lint: mirror[cancel-queued] begin
                     matched = set()
                     for r in [r for r in pending if r.uid in cancels]:
                         pending.remove(r)
                         matched.add(r.uid)
+                        pf._tick_cancelled += 1
                         pf._finish_unadmitted(
                             r, tick, OUTCOME_CANCELLED, results,
                             visible_wall.pop(r.uid, now), now,
                         )
+                    # lint: mirror[cancel-queued] end
                     for eng in (pf, dc):
                         for i, rq in enumerate(eng._slot_req):
                             if rq is not None and rq.uid in cancels:
                                 matched.add(rq.uid)
+                                pf._tick_cancelled += 1
                                 eng._retire(i, tick, OUTCOME_CANCELLED,
                                             results)
+                    # lint: mirror[cancel-carry] begin
                     for uid in cancels - matched:
                         if uid not in cancel_carry:
                             cancel_carry[uid] = 2
@@ -587,31 +605,39 @@ class DisaggServer:
                                 del cancel_carry[uid]
                     for uid in matched:
                         cancel_carry.pop(uid, None)
+                    # lint: mirror[cancel-carry] end
+                # lint: mirror[deadline-queued] begin
                 for r in [r for r in pending
                           if r.deadline_s is not None
                           and now >= r.deadline_s]:
                     pending.remove(r)
+                    pf._tick_deadline += 1
                     pf._finish_unadmitted(
                         r, tick, OUTCOME_DEADLINE, results,
                         visible_wall.pop(r.uid, now), now,
                     )
+                # lint: mirror[deadline-queued] end
                 for eng in (pf, dc):
                     for i, rq in enumerate(eng._slot_req):
                         if (rq is not None and rq.deadline_s is not None
                                 and now >= rq.deadline_s):
+                            pf._tick_deadline += 1
                             eng._retire(i, tick, OUTCOME_DEADLINE, results)
                 # The sweep may have retired parked requests out of their
                 # slots — drop them from the handoff FIFO.
                 handoff_fifo = [p for p in handoff_fifo
                                 if pf._slot_state[p] == "handoff"]
                 if draining:
+                    # lint: mirror[drain-shed] begin
                     source.close()
                     while pending:
                         r = pending.popleft()
+                        pf._tick_shed += 1
                         pf._finish_unadmitted(
                             r, tick, OUTCOME_SHED, results,
                             visible_wall.pop(r.uid, now), now,
                         )
+                    # lint: mirror[drain-shed] end
 
                 # Adopt: oldest parked request per free decode slot —
                 # the zero-copy handoff step.
@@ -658,6 +684,31 @@ class DisaggServer:
                 if not busy:
                     # Idle handling stays BEFORE the tick body (the
                     # executed-ticks == recorded-ticks invariant).
+                    if FLIGHT.enabled:
+                        rec = None
+                        # lint: mirror[sweep-only] begin
+                        if (pf._tick_cancelled or pf._tick_deadline
+                                or pf._tick_shed):
+                            # The sweep retired work and left the tick
+                            # idle; without this record the counters are
+                            # zeroed at the next tick top and the storm
+                            # vanishes from the black box.
+                            rec = {
+                                "tick": tick,
+                                "sweep_only": True,
+                                "occupancy": 0,
+                                "queue_depth": queue_depth,
+                                "pending": len(pending),
+                                "cancelled": pf._tick_cancelled,
+                                "deadline_expired": pf._tick_deadline,
+                                "shed": pf._tick_shed,
+                                "draining": draining,
+                            }
+                        # lint: mirror[sweep-only] end
+                        if rec is not None:
+                            rec["worker"] = "prefill"
+                            FLIGHT.record(rec)
+                    # lint: mirror[idle] begin
                     if source.exhausted or draining:
                         break
                     nxt = source.next_arrival()
@@ -668,6 +719,7 @@ class DisaggServer:
                             FLIGHT.mark_idle()
                         source.wait(0.05)
                     continue
+                    # lint: mirror[idle] end
 
                 # ---- prefill-worker tick: chunks only, no decode rows.
                 tp0 = time.monotonic()
@@ -786,6 +838,11 @@ class DisaggServer:
                         "queue_depth": queue_depth,
                         "prefix_hits": pf._tick_prefix_hits,
                         "prefix_reused": pf._tick_prefix_reused,
+                        # Robustness arcs this tick (the fused engine's
+                        # black-box keys — a storm reads the same way).
+                        "cancelled": pf._tick_cancelled,
+                        "deadline_expired": pf._tick_deadline,
+                        "shed": pf._tick_shed,
                         **({"restored_blocks": pf._tick_restored,
                             "host_blocks_used": self.host_pool.used}
                            if self.host_pool is not None else {}),

@@ -2416,6 +2416,7 @@ class SlotServer:
                 # request must not kill the loop serving everyone else —
                 # it finishes unserved with outcome 'error' (static
                 # traces were validated up front and still raise).
+                # lint: mirror[ingest] begin
                 for r in source.poll(tick):
                     vis = r.visible_at if r.visible_at is not None else now
                     try:
@@ -2431,6 +2432,7 @@ class SlotServer:
                     if obs.TRACER.active:
                         obs.instant("request_queued", cat="serving",
                                     args={"rid": r.uid, "tick": tick})
+                # lint: mirror[ingest] end
 
                 # Control sweep (ISSUE 10): mailboxed cancellations,
                 # expired deadlines, drain — applied at tick start so
@@ -2443,6 +2445,7 @@ class SlotServer:
                 cancels, draining = self._take_control()
                 cancels |= set(cancel_carry)
                 if cancels:
+                    # lint: mirror[cancel-queued] begin
                     matched = set()
                     for r in [r for r in pending if r.uid in cancels]:
                         pending.remove(r)
@@ -2452,6 +2455,7 @@ class SlotServer:
                             r, tick, OUTCOME_CANCELLED, results,
                             visible_wall.pop(r.uid, now), now,
                         )
+                    # lint: mirror[cancel-queued] end
                     for i, rq in enumerate(self._slot_req):
                         if rq is not None and rq.uid in cancels:
                             matched.add(rq.uid)
@@ -2464,6 +2468,7 @@ class SlotServer:
                     # unmatched uids for a couple of sweeps so the
                     # request is caught the moment it is ingested;
                     # genuinely unknown/finished uids age out as no-ops.
+                    # lint: mirror[cancel-carry] begin
                     for uid in cancels - matched:
                         if uid not in cancel_carry:
                             cancel_carry[uid] = 2
@@ -2473,18 +2478,21 @@ class SlotServer:
                                 del cancel_carry[uid]
                     for uid in matched:
                         cancel_carry.pop(uid, None)
+                    # lint: mirror[cancel-carry] end
+                # Expired in queue: reject unserved — admitting work
+                # that can no longer meet its deadline only steals
+                # tick budget from requests that still can.
+                # lint: mirror[deadline-queued] begin
                 for r in [r for r in pending
                           if r.deadline_s is not None
                           and now >= r.deadline_s]:
-                    # Expired in queue: reject unserved — admitting work
-                    # that can no longer meet its deadline only steals
-                    # tick budget from requests that still can.
                     pending.remove(r)
                     self._tick_deadline += 1
                     self._finish_unadmitted(
                         r, tick, OUTCOME_DEADLINE, results,
                         visible_wall.pop(r.uid, now), now,
                     )
+                # lint: mirror[deadline-queued] end
                 for i, rq in enumerate(self._slot_req):
                     if (rq is not None and rq.deadline_s is not None
                             and now >= rq.deadline_s):
@@ -2496,6 +2504,7 @@ class SlotServer:
                     # Graceful drain: close the source, shed everything
                     # still queued, keep stepping the in-flight slots to
                     # completion.
+                    # lint: mirror[drain-shed] begin
                     source.close()
                     while pending:
                         r = pending.popleft()
@@ -2504,6 +2513,7 @@ class SlotServer:
                             r, tick, OUTCOME_SHED, results,
                             visible_wall.pop(r.uid, now), now,
                         )
+                    # lint: mirror[drain-shed] end
 
                 # Admit: oldest visible request per free slot. Chunked
                 # admission is pure bookkeeping (the chunks run inside the
@@ -2546,6 +2556,30 @@ class SlotServer:
                     # /healthz contract — an idle server is not a
                     # stalled one) and block briefly for submissions
                     # (wakes early on submit/close).
+                    if FLIGHT.enabled:
+                        rec = None
+                        # lint: mirror[sweep-only] begin
+                        if (self._tick_cancelled or self._tick_deadline
+                                or self._tick_shed):
+                            # The sweep retired work and left the tick
+                            # idle; without this record the counters are
+                            # zeroed at the next tick top and the storm
+                            # vanishes from the black box.
+                            rec = {
+                                "tick": tick,
+                                "sweep_only": True,
+                                "occupancy": 0,
+                                "queue_depth": queue_depth,
+                                "pending": len(pending),
+                                "cancelled": self._tick_cancelled,
+                                "deadline_expired": self._tick_deadline,
+                                "shed": self._tick_shed,
+                                "draining": draining,
+                            }
+                        # lint: mirror[sweep-only] end
+                        if rec is not None:
+                            FLIGHT.record(rec)
+                    # lint: mirror[idle] begin
                     if source.exhausted or draining:
                         break
                     nxt = source.next_arrival()
@@ -2556,6 +2590,7 @@ class SlotServer:
                             FLIGHT.mark_idle()
                         source.wait(0.05)
                     continue
+                    # lint: mirror[idle] end
 
                 # Plan this tick's prefill chunks (chunked admission only).
                 plan = (self._plan_chunks()

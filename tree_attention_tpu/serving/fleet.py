@@ -405,6 +405,7 @@ class FleetSupervisor:
             with self._lock:
                 if name in self._maintenance:
                     return
+            # lint: allow[lock-order] _op_lock deliberately serializes whole recovery sequences (health probe included); state snapshots use self._lock, which never blocks
             if rep.ready():
                 return
             self.router.mark_down(name)
@@ -416,7 +417,9 @@ class FleetSupervisor:
             log.warning("fleet: replica %s unhealthy; restarting "
                         "(attempt %d/%d)", name, used + 1, self.restarts)
             try:
+                # lint: allow[lock-order] the drain handshake must run under _op_lock — releasing it mid-recovery is exactly the double-drain race the lock exists to prevent
                 rep.begin_drain()
+                # lint: allow[lock-order] bounded in practice (30s drain + grace escalation to SIGKILL); _op_lock must be held or a rolling restart could double-serve the engine
                 if not rep.await_drained(timeout_s=30.0):
                     # Wedged drain: the engine thread still owns its
                     # serve loop — restarting now would double-serve the
@@ -424,6 +427,7 @@ class FleetSupervisor:
                     log.error("fleet: replica %s drain timed out; "
                               "leaving it down", name)
                     return
+                # lint: allow[lock-order] restart-until-ready stays inside the serialized recovery section; start_timeout_s bounds it
                 port = rep.restart()
             except (RuntimeError, OSError) as e:
                 log.error("fleet: replica %s restart failed: %s", name, e)
