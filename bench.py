@@ -725,6 +725,25 @@ def _serving_tiered_record():
     return bench_serving_tiered_kv()
 
 
+def _serving_forked_record():
+    """Copy-on-write forked sampling (ISSUE 15): one prefill fans out to
+    n completions whose block tables SHARE every full ancestor block
+    (vLLM's CoW fork over PagedAttention tables, arXiv:2309.06180) —
+    n=8 must peak at <= 2x the pool bytes of n=1 at this shape (naive
+    is 8x), per-branch TTFT p50 within 1.3x (the prompt prefills once
+    per family), fork_share_ratio = the fraction of a sibling's
+    worst-case blocks served by refcount sharing. Parity-gated twice:
+    greedy n=8 token-identical to 8 independent requests, sampled
+    families bit-reproducible across serves (per-request PRNG keys).
+    CPU proxy; the sharing economics are ledger math and transfer
+    exactly. See tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import (
+        bench_serving_forked_sampling,
+    )
+
+    return bench_serving_forked_sampling()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -963,6 +982,7 @@ def _run_suite() -> None:
     run("serving_fleet", _serving_fleet_record)
     run("serving_disagg", _serving_disagg_record)
     run("serving_tiered_kv", _serving_tiered_record)
+    run("serving_forked_sampling", _serving_forked_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1122,6 +1142,15 @@ def _summarize_record(name, rec):
         cc = rec.get("int8_capacity", {}).get("max_concurrent_improvement")
         if cc is not None:
             out["int8_max_concurrent_improvement"] = cc
+    if name == "serving_forked_sampling":
+        fam = rec.get("family", {})
+        for key in ("pool_bytes_ratio", "fork_share_ratio",
+                    "pool_bytes_per_completion"):
+            if key in fam:
+                out[key] = fam[key]
+        ratio = rec.get("trace", {}).get("ttft_p50_ratio")
+        if ratio is not None:
+            out["fork_ttft_p50_ratio"] = ratio
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

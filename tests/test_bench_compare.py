@@ -111,9 +111,60 @@ _SPEC.loader.exec_module(bc)
     ("prefix_population_blocks", None),
     ("pool_blocks_int8", None),
     ("bytes_ratio", None),
+    # Copy-on-write fork family (ISSUE 15): the sharing-effectiveness
+    # ratio is larger-is-better, the per-completion/peak-bytes/TTFT
+    # ratios smaller-is-better (growth = regressing toward the naive
+    # n-times cost), and fork/branch counts + block-count echoes are
+    # workload shape that skips.
+    ("fork_share_ratio", bc.LARGER_IS_BETTER),
+    ("pool_bytes_per_completion", bc.SMALLER_IS_BETTER),
+    ("pool_bytes_per_completion_n1", bc.SMALLER_IS_BETTER),
+    ("pool_bytes_ratio", bc.SMALLER_IS_BETTER),
+    ("fork_ttft_p50_ratio", bc.SMALLER_IS_BETTER),
+    ("forks", None),
+    ("branches", None),
+    ("fork_blocks_shared_total", None),
+    ("shared_blocks", None),
+    ("peak_blocks_n1", None),
+    ("peak_blocks_family", None),
+    ("completions_family", None),
+    ("naive_pool_bytes_ratio", None),
+    ("fork_at", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family
+
+
+def test_compare_flags_fork_sharing_regression():
+    # Sharing collapsing toward the naive n-times cost IS the
+    # regression (pool bytes per completion and the peak ratio grow,
+    # share ratio drops); fork counts moving with the trace is not.
+    base = {"serving_forked_sampling": {"family": {
+        "pool_bytes_per_completion": 15360.0, "pool_bytes_ratio": 1.875,
+        "fork_share_ratio": 0.875, "forks": 7,
+    }}}
+    cand = {"serving_forked_sampling": {"family": {
+        "pool_bytes_per_completion": 61440.0, "pool_bytes_ratio": 7.5,
+        "fork_share_ratio": 0.1, "forks": 21,
+    }}}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 3
+    assert any("pool_bytes_per_completion" in r for r in regs)
+    assert any("pool_bytes_ratio" in r for r in regs)
+    assert any("fork_share_ratio" in r for r in regs)
+
+
+def test_compare_fork_ttft_ratio_routes_smaller_better():
+    base = {"serving_forked_sampling": {"trace": {"ttft_p50_ratio": 1.02}}}
+    cand = {"serving_forked_sampling": {"trace": {"ttft_p50_ratio": 2.9}}}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 1 and "ttft_p50_ratio" in regs[0]
+    # ...and an IMPROVED ratio is not a regression.
+    regs, _ = bc.compare(cand, base, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert regs == []
 
 
 def test_compare_flags_disagg_interference_regression():

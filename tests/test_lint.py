@@ -1342,6 +1342,64 @@ class TestLedgerLeak:
         ), path="tree_attention_tpu/serving/block_pool.py")
         assert fs == []
 
+    def test_fork_shared_unledgered_flagged(self):
+        # fork_shared refcounts blocks into a child's table — the bid
+        # list must land in a per-slot shared ledger so BOTH retires
+        # release (ISSUE 15); dropping it on any arc is the leak.
+        fs = run("ledger-leak", (
+            "class S:\n"
+            "    def _fork_child(self, parent, child, bids):\n"
+            "        shared = self._pool.fork_shared(bids)\n"
+            "        self._host_table[child, 0] = 0\n"
+        ))
+        assert len(fs) == 1 and "shared" in fs[0].message \
+            and "fork_shared" in fs[0].message
+
+    def test_fork_shared_stored_in_ledger_clean(self):
+        fs = run("ledger-leak", (
+            "class S:\n"
+            "    def _fork_child(self, parent, child, bids):\n"
+            "        self._slot_shared[child] = set(\n"
+            "            self._pool.fork_shared(bids)\n"
+            "        )\n"
+        ))
+        assert fs == []
+
+    def test_repin_dropped_on_exit_arc_flagged(self):
+        # repin takes one MORE pin per node of the parent's path — the
+        # child's pins must be ledgered (released at ITS retire), and
+        # inspecting them is not releasing them.
+        fs = run("ledger-leak", (
+            "class S:\n"
+            "    def _fork_child(self, parent, child, nshare):\n"
+            "        nodes = self._prefix.repin(self._slot_nodes[parent])\n"
+            "        if nshare == 0:\n"
+            "            return None\n"
+            "        self._slot_nodes[child] = nodes\n"
+        ))
+        assert len(fs) == 1 and "nodes" in fs[0].message \
+            and "repin" in fs[0].message
+
+    def test_repin_ledgered_clean(self):
+        fs = run("ledger-leak", (
+            "class S:\n"
+            "    def _fork_child(self, parent, child):\n"
+            "        nodes = self._prefix.repin(self._slot_nodes[parent])\n"
+            "        self._slot_nodes[child] = nodes\n"
+        ))
+        assert fs == []
+
+    def test_repin_receiver_scoped_like_match(self):
+        # A non-prefix receiver's repin (some future cache with the same
+        # verb) is not a radix pin and must not fire.
+        fs = run("ledger-leak", (
+            "class S:\n"
+            "    def f(self):\n"
+            "        x = self._scores.repin([1, 2])\n"
+            "        return None\n"
+        ))
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # mirror-drift (ISSUE 14)
@@ -1519,14 +1577,14 @@ class TestReintroduction:
         eng = tmp_path / "tree_attention_tpu" / "serving" / "engine.py"
         text = eng.read_text()
         needle = (
-            "        if not self._pool.reserve(needed):\n"
+            "        if not self._pool.reserve(needed + fam_extra):\n"
             "            if nodes:\n"
             "                self._prefix.release(nodes)\n"
             "            return None\n"
         )
         assert needle in text, "the reserve idiom moved; update this test"
         eng.write_text(text.replace(needle, (
-            "        if not self._pool.reserve(needed):\n"
+            "        if not self._pool.reserve(needed + fam_extra):\n"
             "            return None\n"
         ), 1))
         rc = lint_main(["--root", root, "--rules", "ledger-leak",
@@ -1548,6 +1606,33 @@ class TestReintroduction:
                         "tree_attention_tpu/serving/disagg.py"])
         out = capsys.readouterr().out
         assert rc == 1 and "mirror[cancel-carry]" in out
+
+    def test_editing_fork_sweep_one_side_fails_lint(self, tmp_path,
+                                                    capsys):
+        # The fork control-sweep arc (ISSUE 15) is a mirrored region:
+        # growing the engine's side (an extra statement) without the
+        # hand-port to disagg.py must fail lint from EITHER file.
+        root = self._copy_tree(tmp_path)
+        eng = tmp_path / "tree_attention_tpu" / "serving" / "engine.py"
+        text = eng.read_text()
+        needle = (
+            "                forks = self._take_forks()\n"
+            "                if forks or self._fork_carry:\n"
+        )
+        assert needle in text, "the fork sweep moved; update this test"
+        eng.write_text(text.replace(needle, (
+            "                forks = self._take_forks()\n"
+            "                forks = sorted(forks)\n"
+            "                if forks or self._fork_carry:\n"
+        ), 1))
+        for target in ("engine.py", "disagg.py"):
+            rc = lint_main([
+                "--root", root, "--rules", "mirror-drift",
+                "--baseline", str(tmp_path / "b.json"),
+                f"tree_attention_tpu/serving/{target}",
+            ])
+            out = capsys.readouterr().out
+            assert rc == 1 and "mirror[fork]" in out, (target, out)
 
 
 # ---------------------------------------------------------------------------
@@ -1589,20 +1674,24 @@ class TestFullPackage:
                        cwd=lintlib.REPO_ROOT)
 
     def test_engine_tick_fetch_is_annotated(self):
-        # The ONE per-tick host sync is allow[]-annotated, not unscoped.
+        # The per-tick host syncs are allow[]-annotated, not unscoped:
+        # the verify-tick fused fetch, the mixed tick's token+logprob
+        # fused fetch (ISSUE 15), and the awaits-only tick's token +
+        # logprob pair.
         path = os.path.join(lintlib.REPO_ROOT, ENGINE)
         with open(path) as fh:
             text = fh.read()
-        assert text.count("lint: allow[host-sync]") == 2
+        assert text.count("lint: allow[host-sync]") == 4
 
     def test_disagg_tick_fetches_are_annotated(self):
-        # One fetch per worker per tick, all annotated: the prefill
-        # worker's await fetch, the decode worker's fused-verify fetch,
-        # and the decode worker's plain token fetch (ISSUE 12).
+        # One fetch point per worker per tick, all annotated: the
+        # prefill worker's await fetch (token + logprob, ISSUE 15), the
+        # decode worker's fused-verify fetch, and the decode worker's
+        # fused token+logprob fetch.
         path = os.path.join(lintlib.REPO_ROOT, DISAGG)
         with open(path) as fh:
             text = fh.read()
-        assert text.count("lint: allow[host-sync]") == 3
+        assert text.count("lint: allow[host-sync]") == 4
 
 
 class TestRunner:

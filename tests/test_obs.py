@@ -716,7 +716,10 @@ class TestDisabledOverhead:
         # the engine's verify commit calls these module-level metrics
         # only under REGISTRY.enabled — exercised here through the real
         # objects (registered on the global, disabled registry).
+        # The copy-on-write fork hooks (ISSUE 15) ride the same guard:
+        # _fork_child bumps these only under REGISTRY.enabled.
         from tree_attention_tpu.serving.engine import (
+            _FORKS, _FORK_SHARED,
             _SPEC_ACCEPTED, _SPEC_ACCEPT_RATIO, _SPEC_PROPOSED,
         )
 
@@ -728,6 +731,8 @@ class TestDisabledOverhead:
             _SPEC_PROPOSED.inc(4)
             _SPEC_ACCEPTED.inc(2)
             _SPEC_ACCEPT_RATIO.set(0.5)
+            _FORKS.inc()
+            _FORK_SHARED.inc(7)
             with tracer.span("phase"):
                 pass
             tracer.instant("event")

@@ -369,6 +369,44 @@ class TestExitArcs:
         assert len(swept) == 1 and swept[0]["deadline_expired"] == 1
         assert_drained(srv)
 
+    def test_fork_mid_generation_on_decode_pool(self, params):
+        """ISSUE 15 on the pair: ``fork_at`` branches a live request on
+        the DECODE worker through the mirrored fork sweep — both
+        branches carry the shared stream prefix (greedy: identical
+        continuations), the CoW-shared blocks release on every retire,
+        and n>1 families are rejected with a clear error (siblings
+        would need slots on both sides of the handoff)."""
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        rep = srv.serve([Request(uid=60, prompt=RAND_PROMPT,
+                                 max_new_tokens=8, fork_at=2)])
+        res = {r.index: r.tokens for r in rep.results}
+        assert sorted(res) == [0, 1]
+        assert res[0][:2] == res[1][:2]
+        assert res[0] == res[1]  # greedy branches stay identical
+        assert srv.leak_report()["blocks_shared"] == 0
+        assert_drained(srv)
+        with pytest.raises(ValueError,
+                           match="not supported on this engine"):
+            srv.serve([Request(uid=61, prompt=RAND_PROMPT,
+                               max_new_tokens=4, n=2)])
+        assert_drained(srv)
+
+    def test_fork_waits_through_prefill_and_handoff(self, params):
+        """A fork aimed at a request still on the PREFILL side (queued,
+        chunking, or parked for handoff) must WAIT until the decode
+        worker adopts it — the decode-side sweep cannot see it yet,
+        but dropping it as unknown would lose the branch (ISSUE 15
+        review fix)."""
+        srv = _disagg(params, "main", prefix_cache=True, prefix_block=8)
+        srv.fork(70)  # mailboxed before the request even admits
+        rep = srv.serve([Request(uid=70, prompt=LOOP_PROMPT,
+                                 max_new_tokens=8)])
+        res = {r.index: r.tokens for r in rep.results}
+        assert sorted(res) == [0, 1], res
+        assert res[0] == res[1]  # greedy branches stay identical
+        assert not srv._fork_carry
+        assert_drained(srv)
+
 
 # ---------------------------------------------------------------------------
 # the allocator's transfer audit + construction contracts

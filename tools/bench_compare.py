@@ -68,13 +68,24 @@ _LARGER_SUBSTRINGS = (
     # the trace came back for (hit_rate itself classifies above) — a
     # tier-effectiveness ratio, larger is better.
     "restore_ratio",
+    # Copy-on-write fork family (ISSUE 15): the fraction of a forked
+    # sibling's worst-case blocks served by refcount sharing instead of
+    # allocation — the CoW effectiveness ratio, larger is better.
+    "fork_share_ratio",
 )
 # Ratio-shaped keys where SMALLER is better (checked before the
 # larger-is-better substrings — "cost" beats "ratio").
 # interference_ratio (ISSUE 12): loaded-over-unloaded decode TBT p99 —
 # the disaggregation headline; 1.0 = perfect isolation, growth is the
 # interference the split exists to remove.
-_SMALLER_SUBSTRINGS = ("cost_ratio", "interference_ratio")
+_SMALLER_SUBSTRINGS = (
+    "cost_ratio", "interference_ratio",
+    # Copy-on-write fork family (ISSUE 15): pool bytes per completion
+    # (the n>1 economics headline), the family-over-single peak-bytes
+    # ratio, and the family-over-single TTFT p50 ratio — growth in any
+    # of them is sharing regressing toward the naive n-times cost.
+    "pool_bytes_per_completion", "pool_bytes_ratio", "ttft_p50_ratio",
+)
 _EXACT_SUFFIXES = ("_total", "_bytes", "_count")
 _SMALLER_SUFFIXES = ("_us", "_s", "_seconds", "_ms")
 _SMALLER_EXACT_KEYS = ("median", "mean", "wall_s", "p50", "p95", "p99")
@@ -121,6 +132,16 @@ _IGNORE_KEYS = frozenset((
     "host_drops", "restored_blocks", "device_pool_blocks",
     "prefix_population_blocks", "pool_blocks_exact", "pool_blocks_int8",
     "bytes_ratio",
+    # Copy-on-write fork record (ISSUE 15): fork/branch counts and
+    # block-count echoes are workload shape (deterministic ledger math
+    # at a fixed config), not performance — the guarded metrics of the
+    # family are pool_bytes_per_completion / pool_bytes_ratio /
+    # ttft_p50_ratio (smaller-better) and fork_share_ratio
+    # (larger-better).
+    "forks", "branches", "fork_blocks_shared_total", "shared_blocks",
+    "peak_blocks_n1", "peak_blocks_family", "completions_n1",
+    "completions_family", "tokens_family", "naive_pool_bytes_ratio",
+    "fork_at",
 ))
 
 

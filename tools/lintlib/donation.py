@@ -61,21 +61,26 @@ _SCOPE = (
 #: engine.py's discovered table — see _check_table_drift.
 SLOTSERVER_DONATIONS: Dict[str, Tuple[int, ...]] = {
     "_mixed": (6,),
-    "_insert": (0, 1),
+    "_insert": (0, 1, 2),
     "_stage_chunk": (3,),
-    "_stage_final": (3, 4, 5),
+    "_stage_final": (3, 4, 5, 6),
     "_whole_suffix": (7,),
     "_spec_lin": (8,),
     "_spec_tree": (10,),
     "_compact": (0,),
     "_dequant_hit": (0,),
+    # Copy-on-write forking (ISSUE 15): the per-slot key seeding and
+    # the fork's tail-block copy both donate their first operand.
+    "_seed_key": (0,),
+    "_fork_copy": (0,),
 }
 
 #: SlotServer helpers that dispatch donating programs internally and
 #: rebind the receiver's own cache before returning: a call through
 #: receiver R consumes R.cache's ALIASES (the other worker's view) and
 #: leaves R.cache itself fresh.
-DISPATCHER_HELPERS = {"_run_staged_chunk", "_spec_commit_all"}
+DISPATCHER_HELPERS = {"_run_staged_chunk", "_spec_commit_all",
+                      "_apply_forks", "_fork_live", "_fork_child"}
 
 _ALIAS_RE = re.compile(r"#\s*lint:\s*donated-alias\[([^\]]+)\]")
 

@@ -67,11 +67,18 @@ _ACQUIRES: Dict[str, Tuple[str, Optional[int]]] = {
     "match": ("pins", 1),
     "insert": ("pins", 0),
     "adopt": ("pins", 0),
+    # Copy-on-write forking (ISSUE 15): fork_shared refcounts full
+    # ancestor blocks into a child's table (the returned bid list must
+    # land in a per-slot shared ledger so BOTH retires release), and
+    # repin takes one more pin per node of an already-pinned radix path
+    # (the child's pins, released through its own retire).
+    "fork_shared": ("block", None),
+    "repin": ("pins", None),
 }
 #: Acquire names that only count on a prefix-index receiver (``match``
 #: etc. are common verbs; ``self._trees[n].match`` in the router is an
 #: int score, not a pin).
-_PREFIX_ONLY = {"match", "insert", "adopt"}
+_PREFIX_ONLY = {"match", "insert", "adopt", "repin"}
 _PIN_SINK_CALLS = {"release", "adopt"}
 
 

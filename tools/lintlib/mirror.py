@@ -140,8 +140,12 @@ def normalize_region(stmts: List[ast.stmt]) -> List[str]:
     for st in stmts:
         # Transform a deep copy — the statements belong to the Source's
         # shared tree, and every other pass still has to analyze the
-        # original identifiers after this one runs.
-        mod = ast.Module(body=[copy.deepcopy(st)], type_ignores=[])
+        # original identifiers after this one runs. The copy must CUT
+        # the Source's upward ``_lint_parent`` chain (seeded memo):
+        # following it would deep-copy the entire module per statement,
+        # which once put the whole-repo lint past its 10 s budget.
+        memo = {id(getattr(st, "_lint_parent", None)): None}
+        mod = ast.Module(body=[copy.deepcopy(st, memo)], type_ignores=[])
         mod = norm.visit(ast.fix_missing_locations(mod))
         out.append(ast.dump(mod, annotate_fields=False,
                             include_attributes=False))

@@ -524,6 +524,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             "--speculate requires greedy decoding: pass --temperature 0 "
             "(the greedy accept rule is what makes speculation exact)"
         )
+    if cfg.top_k < 0:
+        raise SystemExit("--top-k must be >= 0 (0 = off)")
+    if cfg.temperature < 0:
+        raise SystemExit("--temperature must be >= 0 (0 = greedy)")
     if cfg.speculate and not 1 <= cfg.draft_k <= 31:
         raise SystemExit("--draft-k must be in [1, 31]")
     if not 0.0 <= cfg.prefix_share <= 1.0:
@@ -617,7 +621,7 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         slots=cfg.slots, cache_len=cache_len, mesh=mesh,
         quantize=cfg.kv_quant != "none",
         quant_kernel=cfg.resolved_quant_kernel() or "q8q",
-        temperature=cfg.temperature, seed=cfg.seed + 2,
+        temperature=cfg.temperature, top_k=cfg.top_k, seed=cfg.seed + 2,
         prefill_chunk=cfg.prefill_chunk,
         prefill_budget=cfg.prefill_budget,
         admission=cfg.admission,

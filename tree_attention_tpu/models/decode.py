@@ -346,6 +346,31 @@ def scatter_kv_blocks(
     return tuple(out)
 
 
+def copy_pool_block(cache, src: jax.Array, dst: jax.Array):
+    """The copy-on-write fork's ONE device copy (ISSUE 15): duplicate
+    pool block ``src`` into freshly allocated block ``dst`` — K and V
+    rows, plus the per-block scale scalars under int8, so the copy is
+    self-contained whichever tier quantization runs at. Full ancestor
+    blocks are SHARED by refcount (zero bytes); only the partial tail
+    block a forked branch will append into needs its own copy, and this
+    is that copy. ``src == dst`` degenerates to an identical-bytes
+    self-write (the engine's no-partial-tail arc reuses one compiled
+    program that way). Works on :class:`PagedKVCache` and
+    :class:`PagedQuantKVCache`."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    new = dict(
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
+    if isinstance(cache, PagedQuantKVCache):
+        new.update(
+            k_scale=cache.k_scale.at[:, dst].set(cache.k_scale[:, src]),
+            v_scale=cache.v_scale.at[:, dst].set(cache.v_scale[:, src]),
+        )
+    return dataclasses.replace(cache, **new)
+
+
 def insert_dequant_prefix(
     staging: KVCache,
     pool_k: jax.Array,
@@ -1215,6 +1240,71 @@ def _sample(logits: jax.Array, temperature: float, key: Optional[jax.Array]):
     return jax.random.categorical(key, logits / temperature, axis=-1).astype(
         jnp.int32
     )
+
+
+def sample_slots(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    keys: jax.Array,
+    sample_idx: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-slot sampling for the serving tick (ISSUE 15): temperature /
+    top-k categorical where ``temperature[i] > 0``, exact argmax where it
+    is 0 — value-identical to the greedy path, so temperature-0 slots
+    keep every existing parity gate.
+
+    The PRNG discipline is the reproducibility contract: slot ``i``'s
+    randomness for its ``j``-th emitted token is
+    ``fold_in(keys[i], sample_idx[j])`` — a pure function of the
+    REQUEST's key and the token's stream index, independent of tick
+    interleaving, chunk mixtures, batch composition, or how many forked
+    siblings share the batch. Two serves of the same trace with the same
+    seeds therefore sample bit-identically, and a forked sibling (its
+    own key) diverges from its parent at exactly the fork point.
+
+    Args:
+      logits: ``(S, V)`` last-row logits.
+      temperature: ``(S,)`` float32 per-slot temperature (0 = greedy).
+      top_k: ``(S,)`` int32 per-slot top-k cutoff (0 = off).
+      keys: ``(S, 2)`` uint32 per-slot request keys.
+      sample_idx: ``(S,)`` int32 emitted-token index per slot.
+
+    Returns:
+      ``(tok, logprob)``: ``(S,)`` int32 sampled ids and ``(S,)`` float32
+      UNadjusted model log-probabilities of the chosen tokens (the
+      cumulative-logprob input best-of-n selects on — OpenAI semantics:
+      model logprob, not temperature-scaled).
+    """
+    V = logits.shape[-1]
+    lf = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+
+    def one(lg, t, k, key, idx):
+        sub = jax.random.fold_in(key, idx)
+        # Dynamic per-slot top-k: threshold at the k-th largest logit
+        # (ties keep every logit >= it); k <= 0 disables the mask.
+        srt = jnp.sort(lg)  # ascending
+        kk = jnp.clip(k, 1, V)
+        thresh = srt[V - kk]
+        masked = jnp.where((k > 0) & (lg < thresh), -jnp.inf, lg)
+        t_safe = jnp.where(t > 0, t, 1.0)
+        return jax.random.categorical(sub, masked / t_safe)
+
+    # The sort + categorical run only when some slot actually samples —
+    # an all-greedy tick (the engine default) pays argmax alone, not a
+    # discarded O(V log V) per slot on the hot path.
+    sampled = lax.cond(
+        jnp.any(temperature > 0.0),
+        lambda _: jax.vmap(one)(lf, temperature, top_k, keys,
+                                sample_idx).astype(jnp.int32),
+        lambda _: greedy,
+        operand=None,
+    )
+    tok = jnp.where(temperature > 0.0, sampled, greedy)
+    logp = jax.nn.log_softmax(lf, axis=-1)
+    lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    return tok, lp
 
 
 def generate(

@@ -35,7 +35,7 @@ hundreds of random admit/retire/hit/evict interleavings per second.
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from tree_attention_tpu import obs
 from tree_attention_tpu.utils.logging import get_logger
@@ -55,8 +55,12 @@ _BLOCKS_FREE = obs.gauge(
 # block is owned by the host tier's staging queue: the radix tree evicted
 # it toward host RAM (ISSUE 13), the D2H copy has not run yet, and the
 # block must not be reused until the flush lands it on the host and calls
-# :meth:`BlockAllocator.free_demoted`.
-_FREE, _PRIVATE, _CACHED, _DEMOTED = 0, 1, 2, 3
+# :meth:`BlockAllocator.free_demoted`. A _SHARED block (ISSUE 15) is a
+# copy-on-write fork's full ancestor: refcounted by the slots whose
+# tables map it (cached-style shared ownership, but owned by SLOTS, not
+# the radix tree), append-only by construction (every owner only writes
+# PAST it), freed when the last owner retires.
+_FREE, _PRIVATE, _CACHED, _DEMOTED, _SHARED = 0, 1, 2, 3, 4
 
 
 class BlockAllocator:
@@ -87,6 +91,11 @@ class BlockAllocator:
         # Lifetime count of blocks handed between slot tables via
         # :meth:`transfer_private` (disaggregation accounting).
         self.transferred = 0
+        # Copy-on-write fork accounting (ISSUE 15): per-block owner
+        # refcounts of _SHARED blocks, and the lifetime count of
+        # share edges taken (each fork_shared bid is one edge).
+        self._shared_refs: Dict[int, int] = {}
+        self.fork_shares = 0
         self._evict_one: Optional[Callable[[], bool]] = None
         self._evictable: Optional[Callable[[], int]] = None
         # Demotion staging (ISSUE 13): with a host tier under the pool,
@@ -222,6 +231,63 @@ class BlockAllocator:
         self._state[bid] = _FREE
         self._free.append(bid)
         self.reserved += 1
+
+    # -- copy-on-write fork sharing (ISSUE 15) ----------------------------
+
+    @property
+    def shared_count(self) -> int:
+        """_SHARED blocks currently alive (each counted once, whatever
+        its refcount) — a drained engine must read 0 here."""
+        return len(self._shared_refs)
+
+    def shared_refs(self, bid: int) -> int:
+        """Owner refcount of a shared block (0 when not shared)."""
+        return self._shared_refs.get(bid, 0)
+
+    def fork_shared(self, bids: Iterable[int]) -> List[int]:
+        """A fork shares full ancestor blocks between parent and child:
+        each ``bid`` must be privately owned (first fork — becomes
+        ``_SHARED`` with two owners) or already shared (another sibling
+        forks the same history — one more owner). The bytes never move
+        and never change: shared blocks are full, and every owner only
+        appends PAST them, so refcounting is the whole safety story —
+        exactly vLLM's copy-on-write fork over PagedAttention block
+        tables (arXiv:2309.06180). Returns the bids as the child's
+        shared-ownership set; the caller must ledger it (and the
+        parent's) so BOTH retires release — the ``ledger-leak`` lint
+        pass tracks this acquire site."""
+        out: List[int] = []
+        for bid in bids:
+            if self._state[bid] == _PRIVATE:
+                self._state[bid] = _SHARED
+                self._shared_refs[bid] = 2
+            elif self._state[bid] == _SHARED:
+                self._shared_refs[bid] += 1
+            else:
+                raise AssertionError(
+                    f"block {bid} fork-shared while neither private nor "
+                    f"shared (state {self._state[bid]}) — sharing a "
+                    f"free/cached block would double-own it"
+                )
+            self.fork_shares += 1
+            out.append(bid)
+        return out
+
+    def release_shared(self, bid: int) -> None:
+        """One owner of a shared block retires. The last release frees
+        the block (and grows availability — generation bump); earlier
+        ones only drop the refcount."""
+        refs = self._shared_refs.get(bid)
+        assert refs is not None and self._state[bid] == _SHARED, (
+            f"block {bid} shared-released while not shared"
+        )
+        if refs > 1:
+            self._shared_refs[bid] = refs - 1
+            return
+        del self._shared_refs[bid]
+        self._state[bid] = _FREE
+        self._free.append(bid)
+        self.gen += 1
 
     def transfer_private(self, bids: Iterable[int]) -> int:
         """Audited ownership handoff of private blocks between slot

@@ -165,6 +165,7 @@ class DisaggServer:
         quantize: bool = False,
         quant_kernel: str = "q8q",
         temperature: float = 0.0,
+        top_k: int = 0,
         seed: int = 0,
         prefill_chunk: int = 256,
         prefill_budget: Optional[int] = None,
@@ -242,6 +243,7 @@ class DisaggServer:
         common = dict(
             cache_len=cache_len, mesh=mesh, quantize=quantize,
             quant_kernel=quant_kernel, temperature=temperature,
+            top_k=top_k,
             admission="chunked", slo_ttft=slo_ttft, slo_tbt=slo_tbt,
             slo_window=slo_window, kv_layout="paged", kv_block=kv_block,
             block_pool=self.pool, prefix_index=self.prefix_index,
@@ -296,6 +298,12 @@ class DisaggServer:
             # last). The loop relays after restores and flushes at end
             # of tick, mirroring SlotServer.serve.
             self.prefill.attach_host_tier(self.host_pool)
+        # Fork families (ISSUE 15) need sibling slots on the SAME engine
+        # as the parent's prefill — which disaggregation splits across
+        # the handoff — so n/best_of > 1 requests are rejected at
+        # validation; mid-generation fork(uid) still works, applied on
+        # the decode worker (live slots only exist there).
+        self.prefill._fork_ok = False
         # Thread-safe control mailboxes — the ingress's seams. RLock: the
         # drain flag is flipped from SIGTERM handlers (the ingress's
         # install_drain_signals contract), which may interrupt a handler
@@ -303,6 +311,7 @@ class DisaggServer:
         self._lock = threading.RLock()
         self._cancel_uids: set = set()
         self._draining = False
+        self._fork_uids: List[int] = []
         # Lifetime handoff stats (public, loop-thread only; serve() diffs
         # them per run for ServeReport.handoff).
         self.handoffs = 0
@@ -315,6 +324,44 @@ class DisaggServer:
         for handoff, or decoding; unknown uids are a no-op."""
         with self._lock:
             self._cancel_uids.add(uid)
+
+    def fork(self, uid: int) -> None:
+        """Branch live request ``uid`` mid-generation (any thread,
+        ISSUE 15) — applied by the control sweep on the DECODE worker,
+        where live slots exist; the branch shares the request's full
+        ancestor blocks in the pair's ONE pool and retires through the
+        decode worker's one retire path."""
+        with self._lock:
+            self._fork_uids.append(uid)
+
+    def _take_forks(self) -> List[int]:
+        with self._lock:
+            out = self._fork_uids
+            self._fork_uids = []
+            return out
+
+    @property
+    def _fork_carry(self) -> Dict[int, int]:
+        """The decode worker's deferred-fork carry (the sweep's retry
+        state lives where the forks apply)."""
+        return self.decode._fork_carry
+
+    def _apply_forks(self, forks: List[int], tick: int, pending) -> None:
+        """Mirror of the fused engine's fork arc, applied on the decode
+        worker. A request still QUEUED, prefilling, or parked for
+        handoff lives on the prefill side — the decode worker cannot
+        see it, so those uids ride the retry carry (exactly the fused
+        engine's not-yet-live race) instead of aging out as unknown.
+        A fork's tail-block copy donates the SHARED pool arrays, so a
+        sweep that forked anything relays them to the prefill worker
+        before its next dispatch."""
+        upstream = list(pending) + [
+            rq for rq in self.prefill._slot_req if rq is not None
+        ]
+        forked0 = self.decode._forks_life
+        self.decode._apply_forks(forks, tick, upstream)
+        if self.decode._forks_life != forked0:
+            self._relay_pool(self.decode, self.prefill)
 
     def request_drain(self) -> None:
         """Begin graceful drain (any thread): stop admitting, shed the
@@ -354,6 +401,7 @@ class DisaggServer:
             ),
             "blocks_used": self.pool.used,
             "blocks_reserved": self.pool.reserved,
+            "blocks_shared": self.pool.shared_count,
             "blocks_cached": 0,
             "pins": 0,
         }
@@ -418,6 +466,17 @@ class DisaggServer:
         dc._slot_prefix_hit[d] = pf._slot_prefix_hit[p]
         dc._prompt_np[d] = pf._prompt_np[p]
         dc._last_tok_t[d] = pf._last_tok_t[p]
+        # Sampling state moves with the request (ISSUE 15): the PRNG key
+        # row (reproducibility is fold_in(key, stream-index) — the
+        # handoff must not re-derive from the decode worker's base),
+        # per-slot temperature/top-k, the branch index, and the running
+        # cumulative logprob.
+        dc._keys = dc._keys.at[d].set(pf._keys[p])
+        dc._temp_np[d] = pf._temp_np[p]
+        dc._topk_np[d] = pf._topk_np[p]
+        dc._slot_index[d] = pf._slot_index[p]
+        dc._slot_cum_lp[d] = pf._slot_cum_lp[p]
+        dc._slot_shared[d] = set()
         dc._slot_clen[d] = plen  # committed rows = the prompt; the first
         # token is the pending tip (the spec rollback ledger starts here)
         first = dc._slot_tokens[d][-1]
@@ -639,6 +698,15 @@ class DisaggServer:
                         )
                     # lint: mirror[drain-shed] end
 
+                # Copy-on-write fork arc (ISSUE 15): mailboxed
+                # fork(uid)s branch live requests onto free slots
+                # (deferred ones retry from the carry for a few sweeps).
+                # lint: mirror[fork] begin
+                forks = self._take_forks()
+                if forks or self._fork_carry:
+                    self._apply_forks(forks, tick, pending)
+                # lint: mirror[fork] end
+
                 # Adopt: oldest parked request per free decode slot —
                 # the zero-copy handoff step.
                 free_d = dc._free_slots()
@@ -759,12 +827,16 @@ class DisaggServer:
                             reset[slot] = first
                             reset_val[slot] = pf._prefill_start[slot]
                             emit[slot] = last
+                        sidx = np.zeros((pf.slots,), np.int32)
                         pf._sync_table()
-                        pf.tok, pf.cache, pf._key = pf._mixed(
+                        pf.tok, pf._lp, _, _, pf.cache = pf._mixed(
                             pf.params, jnp.asarray(mat),
                             jnp.asarray(n_vec), jnp.asarray(reset),
                             jnp.asarray(reset_val), jnp.asarray(emit),
-                            pf.cache, pf._key,
+                            pf.cache, pf._keys,
+                            jnp.asarray(pf._temp_np),
+                            jnp.asarray(pf._topk_np),
+                            jnp.asarray(sidx), pf._lp,
                         )
                         self._relay_pool(pf, dc)
                         if pf._prefix is not None:
@@ -774,13 +846,16 @@ class DisaggServer:
                     awaits = [i for i, st in enumerate(pf._slot_state)
                               if st == "await"]
                     if awaits:
-                        # lint: allow[host-sync] the prefill worker's one per-tick fetch (final-chunk first tokens)
+                        # lint: allow[host-sync] the prefill worker's one per-tick fetch (final-chunk first tokens + logprobs)
                         pf._tok_host = np.asarray(pf.tok)
+                        # lint: allow[host-sync] rides the same sync point (first-token logprobs)
+                        pf._lp_host = np.asarray(pf._lp)
                         now2 = time.monotonic()
                         for i in awaits:
                             req = pf._slot_req[i]
                             first = int(pf._tok_host[i])
                             pf._slot_tokens[i] = [first]
+                            pf._slot_cum_lp[i] = float(pf._lp_host[i])
                             pf._push_token(req, first)
                             _, vis = pf._slot_admit[i]
                             pf._slot_ttft[i] = max(now2 - vis, 0.0)
@@ -914,13 +989,13 @@ class DisaggServer:
                                 r = pack.rows
                                 depth_m[i, :r] = pack.depth
                                 bits_m[i, :r, :r] = pack.anc
-                            fused_dev, dc.cache, dc._key = dc._spec_tree(
+                            fused_dev, dc.cache = dc._spec_tree(
                                 *args, jnp.asarray(depth_m),
-                                jnp.asarray(bits_m), dc.cache, dc._key,
+                                jnp.asarray(bits_m), dc.cache,
                             )
                         else:
-                            fused_dev, dc.cache, dc._key = dc._spec_lin(
-                                *args, dc.cache, dc._key
+                            fused_dev, dc.cache = dc._spec_lin(
+                                *args, dc.cache
                             )
                         dc.tok = fused_dev[:, 0]
                         # lint: allow[host-sync] the decode worker's one per-tick fetch (fused token vector + verify argmaxes)
@@ -952,32 +1027,53 @@ class DisaggServer:
                                 reset[i] = True
                                 reset_val[i] = plen
                         pending_reset.clear()
+                        for i in list(dc._live_reset):
+                            # A forked child's device length learns the
+                            # fork point at its first consuming tick
+                            # (mirrors the fused engine's fork resets).
+                            if dc._slot_state[i] == "live":
+                                reset[i] = True
+                                reset_val[i] = dc._live_reset.pop(i)
                         for i in live_idx:
                             dc._ensure_blocks(
                                 i, len(dc._slot_req[i].prompt)
                                 + len(dc._slot_tokens[i])
                             )
+                        sidx = np.asarray(
+                            [len(t) for t in dc._slot_tokens], np.int32
+                        )
                         dc._sync_table()
                         if tok_dirty:
                             dc.tok = jnp.asarray(dc._tok_host)
                             tok_dirty = False
-                        dc.tok, dc.cache, dc._key = dc._mixed(
+                        dc.tok, dc._lp, fused_dev, _, dc.cache = dc._mixed(
                             dc.params, dc.tok[:, None],
                             jnp.asarray(n_vec), jnp.asarray(reset),
                             jnp.asarray(reset_val), jnp.asarray(emit),
-                            dc.cache, dc._key,
+                            dc.cache, dc._keys,
+                            jnp.asarray(dc._temp_np),
+                            jnp.asarray(dc._topk_np),
+                            jnp.asarray(sidx), dc._lp,
                         )
                         self._relay_pool(dc, pf)
-                        # lint: allow[host-sync] the decode worker's one per-tick fetch (the batched token vector)
-                        dc._tok_host = np.asarray(dc.tok)
+                        # lint: allow[host-sync] the decode worker's one per-tick fetch (token vector + bitcast logprobs, one fused array)
+                        fh = np.asarray(fused_dev)
+                        dc._tok_host = fh[:, 0]
+                        dc._lp_host = np.ascontiguousarray(
+                            fh[:, 1]
+                        ).view(np.float32)
                         now2 = time.monotonic()
                         decode_ticks += 1
                         occupancy += len(live_idx)
                         for i in live_idx:
                             req = dc._slot_req[i]
                             tok_i = int(dc._tok_host[i])
+                            # Every live decode slot has a first token
+                            # already (handoff adoption, or the fork's
+                            # family pass) — always an inter-token gap.
                             dc._slot_tokens[i].append(tok_i)
-                            dc._push_token(req, tok_i)
+                            dc._slot_cum_lp[i] += float(dc._lp_host[i])
+                            dc._push_token(req, tok_i, dc._slot_index[i])
                             tokens += 1
                             tokens_this_tick += 1
                             gap = max(now2 - dc._last_tok_t[i], 0.0)
@@ -989,6 +1085,15 @@ class DisaggServer:
                             if obs.REGISTRY.enabled:
                                 _TOKENS.inc()
                                 _TBT.observe(gap)
+                            if (req.fork_at is not None
+                                    and dc._slot_index[i] == 0
+                                    and len(dc._slot_tokens[i])
+                                    == req.fork_at):
+                                # Replayable mid-generation branch: the
+                                # request forks itself through the
+                                # pair's mailbox (applied on this
+                                # worker at the next sweep).
+                                self.fork(req.uid)
                             if req.eos_id is not None \
                                     and tok_i == req.eos_id:
                                 dc._retire(i, tick, OUTCOME_EOS, results)

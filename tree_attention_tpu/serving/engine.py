@@ -146,8 +146,8 @@ from tree_attention_tpu.models.decode import (
     PagedKVCache,
     PagedQuantKVCache,
     QuantKVCache,
-    _sample,
     compact_decode_window,
+    copy_pool_block,
     forward_step,
     gather_kv_blocks,
     init_cache,
@@ -156,6 +156,7 @@ from tree_attention_tpu.models.decode import (
     paged_insert_slot,
     quantize_cache,
     quantize_paged_blocks,
+    sample_slots,
     scatter_kv_blocks,
 )
 from tree_attention_tpu.serving.block_pool import BlockAllocator
@@ -222,6 +223,16 @@ _SPEC_ACCEPT_RATIO = obs.gauge(
     "serving_spec_acceptance_ratio",
     "lifetime accepted/proposed draft-token ratio (set per verify tick)",
 )
+_FORKS = obs.counter(
+    "serving_forks_total",
+    "copy-on-write forks performed (n>1 siblings, best-of-n branches, "
+    "and mid-generation fork(uid) branches)",
+)
+_FORK_SHARED = obs.counter(
+    "serving_fork_blocks_shared_total",
+    "full ancestor KV blocks a fork SHARED (radix pins + refcounted "
+    "CoW blocks) instead of copying or recomputing them",
+)
 
 
 # The ONE retire-outcome vocabulary (ISSUE 10): every way a request can
@@ -274,6 +285,27 @@ class Request:
     on_token: Optional[Callable[[int], None]] = None
     on_finish: Optional[Callable[["RequestResult"], None]] = None
     visible_at: Optional[float] = None
+    # Sampling (ISSUE 15) — None defers to the engine's defaults.
+    # ``seed`` salts the request's PRNG key (default: the uid), so a
+    # fixed-seed request resamples bit-identically across serves.
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    seed: Optional[int] = None
+    # Copy-on-write forking (ISSUE 15): ``n > 1`` serves n completions
+    # of one prompt as one prefill + (n-1) forked siblings sharing every
+    # full ancestor KV block; ``best_of = k`` runs k branches and
+    # streams only the winner by cumulative logprob (requires n == 1).
+    # ``fork_at = j`` self-forks the request after its j-th emitted
+    # token (the replayable mid-generation-branch trace knob). Branch
+    # events stream through ``on_branch_token(index, tok)`` /
+    # ``on_branch_finish(index, result)`` when set; otherwise only
+    # branch 0 reaches the legacy ``on_token``/``on_finish``.
+    n: int = 1
+    best_of: Optional[int] = None
+    fork_at: Optional[int] = None
+    on_branch_token: Optional[Callable[[int, int], None]] = None
+    on_branch_finish: Optional[
+        Callable[[int, "RequestResult"], None]] = None
 
 
 @dataclasses.dataclass
@@ -293,6 +325,41 @@ class RequestResult:
     # upstream — the fleet router's approximate-tree feedback (ISSUE 11)
     # reads it from the ingress's usage block.
     prefix_hit_tokens: int = 0
+    # Fork-family branch index (ISSUE 15): 0 = the parent/only branch; a
+    # request with n/best_of > 1 (or mid-generation forks) finishes once
+    # per branch, all under the family's one uid.
+    index: int = 0
+    # Sum of the model log-probabilities of this branch's sampled tokens
+    # — best-of-n's server-side selection key (0.0 under speculation,
+    # which is greedy-only and tracks no logprobs).
+    cum_logprob: float = 0.0
+
+
+@dataclasses.dataclass
+class _ForkFamily:
+    """Host bookkeeping of one n>1 / best-of-n request (ISSUE 15).
+
+    Admission reserves the whole family atomically: the parent's
+    worst-case blocks plus each sibling's worst-case NEW blocks (its
+    total minus the full ancestor blocks it will share), and one slot
+    per branch (siblings park in state ``fpend`` so prefill never
+    deadlocks two half-admitted families against each other). The
+    siblings fork the moment the parent's first token lands — before
+    its EOS check, so even a one-token parent still yields n
+    independent samples — each re-consuming the last prompt token into
+    its own copy-on-write tail block and sampling its own first token
+    under its own key."""
+
+    req: Request
+    parent_slot: int
+    sibling_slots: List[int]
+    sib_reserve: int       # worst-case NEW blocks per sibling
+    hold: int              # unspent family reservation (siblings not yet
+    #                        forked; returned on pre-fork retirement)
+    best_of: bool
+    branches: int
+    forked: bool = False
+    done: List[RequestResult] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -388,6 +455,9 @@ def synthetic_trace(
     prefix_len: int = 0,
     prefix_count: int = 1,
     prefix_seed: Optional[int] = None,
+    n: int = 1,
+    best_of: int = 0,
+    fork_at: int = 0,
 ) -> List[Request]:
     """A reproducible request trace: random prompts, optional length jitter,
     arrivals every ``arrival_every`` ticks (0 = all queued at start).
@@ -403,6 +473,14 @@ def synthetic_trace(
     per-request randomness) can still share one prefix population — the
     shape a warm-pool steady-state measurement needs; ``None`` keeps
     everything on the one ``seed`` stream.
+
+    ``n`` / ``best_of`` / ``fork_at`` (ISSUE 15) stamp the fork-family
+    fields onto every request, so fork workloads replay through the
+    same bench and chaos harnesses as everything else: ``n > 1`` makes
+    each trace entry an n-completion family, ``best_of > 1`` a
+    server-side-selected one, and ``fork_at > 0`` self-forks each
+    request after that many emitted tokens (the mid-generation-branch
+    chaos shape).
     """
     if not 0.0 <= prefix_share <= 1.0:
         raise ValueError(f"prefix_share must be in [0, 1], "
@@ -436,6 +514,9 @@ def synthetic_trace(
             max_new_tokens=max_new_tokens,
             arrival_tick=i * arrival_every,
             eos_id=eos_id,
+            n=max(n, 1),
+            best_of=best_of if best_of > 1 else None,
+            fork_at=fork_at if fork_at > 0 else None,
         ))
     return reqs
 
@@ -646,6 +727,7 @@ class SlotServer:
         quantize: bool = False,
         quant_kernel: str = "q8q",
         temperature: float = 0.0,
+        top_k: int = 0,
         seed: int = 0,
         prefill_chunk: int = 256,
         prefill_budget: Optional[int] = None,
@@ -708,6 +790,9 @@ class SlotServer:
         self.temperature = float(temperature)
         if self.temperature < 0:
             raise ValueError("temperature must be >= 0 (0 = greedy)")
+        self.top_k = int(top_k)
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0 (0 = off)")
         self._speculate = bool(speculate)
         if self._speculate:
             if self.temperature != 0.0:
@@ -730,7 +815,43 @@ class SlotServer:
             slots * self.prefill_chunk if prefill_budget is None
             else prefill_budget
         )
-        self._key = jax.random.PRNGKey(seed)
+        # Per-slot sampling state (ISSUE 15). Each slot's PRNG key is
+        # its REQUEST's key (fold_in(base, seed-or-uid) then the branch
+        # index); the j-th emitted token folds j in — see
+        # models.decode.sample_slots for the reproducibility contract.
+        # The host mirrors (_temp_np/_topk_np) ride every dispatch as
+        # plain operands, so per-request sampling params never recompile.
+        self._base_key = jax.random.PRNGKey(seed)
+        self._keys = jnp.zeros((slots, 2), jnp.uint32)
+        self._lp = jnp.zeros((slots,), jnp.float32)
+        self._lp_host = np.zeros((slots,), np.float32)
+        self._temp_np = np.zeros((slots,), np.float32)
+        self._topk_np = np.zeros((slots,), np.int32)
+        self._slot_index = [0] * slots
+        self._slot_cum_lp = [0.0] * slots
+        self._seed_key = jax.jit(self._seed_key_fn, donate_argnums=(0,))
+        # Copy-on-write fork state (ISSUE 15): live fork families by
+        # uid, per-slot refcount-shared block sets (released — not
+        # freed — on retire; the last owner's release frees), pending
+        # device-length resets for freshly forked live slots, the
+        # fork(uid) mailbox's deferral carry, and per-tick flight
+        # counters.
+        self._families: Dict[int, _ForkFamily] = {}
+        self._slot_shared: List[set] = [set() for _ in range(slots)]
+        self._live_reset: Dict[int, int] = {}
+        self._fork_uids: List[int] = []
+        self._fork_carry: Dict[int, int] = {}
+        self._uid_next_index: Dict[int, int] = {}
+        self._forks_life = 0
+        self._fork_shared_life = 0
+        self._tick_forks = 0
+        self._tick_fork_shared = 0
+        self._fork_copy = jax.jit(self._fork_copy_fn, donate_argnums=(0,))
+        self._sibling_first = jax.jit(self._sibling_first_fn,
+                                      donate_argnums=(0, 1))
+        # Per-slot stash of the prompt-end logits row (device, (V,)) —
+        # kept only while the slot's fork family is waiting to expand.
+        self._slot_logits: List[Optional[Any]] = [None] * slots
 
         kw = {"mesh": mesh} if mesh is not None else {}
         self._fs_kw = dict(kw)
@@ -1012,13 +1133,13 @@ class SlotServer:
         # it (backends without donation just copy).
         self._mixed = jax.jit(self._mixed_fn, donate_argnums=(6,))
         self._prefill = jax.jit(self._prefill_fn)
-        self._insert = jax.jit(self._insert_fn, donate_argnums=(0, 1))
+        self._insert = jax.jit(self._insert_fn, donate_argnums=(0, 1, 2))
         if self._needs_staging:
             self._stage_chunk = jax.jit(
                 self._stage_chunk_fn, donate_argnums=(3,)
             )
             self._stage_final = jax.jit(
-                self._stage_final_fn, donate_argnums=(3, 4, 5)
+                self._stage_final_fn, donate_argnums=(3, 4, 5, 6)
             )
         if self._prefix is not None:
             # Whole-admission prefix hits prefill only the suffix — device-
@@ -1078,11 +1199,36 @@ class SlotServer:
 
     # -- compiled pieces --------------------------------------------------
 
-    def _sample(self, logits: jax.Array, key: jax.Array) -> jax.Array:
-        # The ONE sampling definition is models.decode._sample — the
-        # token-for-token parity contract with generate() depends on the
-        # engine never growing its own variant.
-        return _sample(logits, self.temperature, key)
+    def _seed_key_fn(self, keys, slot, salt, branch):
+        """Install slot ``slot``'s request key: fold the request's salt
+        (its ``seed`` or uid) and the fork-branch index into the
+        engine's base key. Pure function of (engine seed, salt, branch)
+        — the reproducibility root: re-serving the same trace re-derives
+        the same keys, and every forked sibling gets its own stream."""
+        k = jax.random.fold_in(jax.random.fold_in(self._base_key, salt),
+                               branch)
+        return keys.at[slot].set(k)
+
+    def _fork_copy_fn(self, cache, tok_vec, src, dst, slot, tip):
+        """The fork's ONE device dispatch: copy-on-write the partial
+        tail block ``src`` into the child's fresh block ``dst`` (a
+        no-op self-copy when the fork point is block-aligned and no
+        tail exists — ``src == dst == 0``) and park the child's tip
+        token in the device token vector (the pure-decode tick reads
+        tokens from there). Everything else about a fork is host
+        bookkeeping: table row, refcounts, pins."""
+        cache = copy_pool_block(cache, src, dst)
+        tok_vec = lax.dynamic_update_index_in_dim(tok_vec, tip, slot,
+                                                  axis=0)
+        return cache, tok_vec
+
+    def _sample_emit(self, last, keys, temp, topk, idx):
+        """The ONE per-slot sampling call every emitting program shares
+        (models.decode.sample_slots): argmax where the slot's
+        temperature is 0 — value-identical to the legacy greedy path —
+        temperature/top-k categorical under fold_in(key, idx)
+        otherwise. Returns (tokens, model logprobs of the choices)."""
+        return sample_slots(last, temp, topk, keys, idx)
 
     def _chunk_bucket(self, n: int) -> int:
         """Tq bucket for a chunk of ``n`` prompt tokens: power-of-two with
@@ -1094,7 +1240,7 @@ class SlotServer:
         return min(b, self.prefill_chunk)
 
     def _mixed_fn(self, params, tokens, n_tok, reset, reset_val, emit,
-                  cache, key):
+                  cache, keys, temp, topk, idx, lp_vec):
         """THE per-tick program: one mixed-Tq forward_step for every slot.
 
         ``tokens`` is ``(S, Tq)`` (Tq = 1 on pure-decode ticks, a chunk
@@ -1106,10 +1252,16 @@ class SlotServer:
         matched prefix length on a prefix hit (where a contiguous gather
         already set the device length this is a no-op; under the paged
         layout the hit was pure host bookkeeping and THIS is where the
-        device learns it). Each slot samples from its own last valid row;
-        ``emit`` keeps the sample (decode slots and final-chunk slots) or
-        holds the slot's row-0 token (everything else — in particular a
-        parked first token rides through unchanged).
+        device learns it). Each slot samples from its own last valid row
+        under its own key/temperature/top-k (``keys``/``temp``/``topk``/
+        ``idx`` — ISSUE 15; temperature-0 slots are exact argmax);
+        ``emit`` keeps the sample (decode slots and final-chunk slots)
+        or holds the slot's row-0 token AND its parked logprob
+        (everything else — in particular a parked first token rides
+        through unchanged). Returns the token vector, the logprob
+        vector, ONE fused ``(S, 2)`` int32 fetch vehicle (tokens +
+        bitcast logprobs — the per-tick host sync stays a single
+        array), and the cache.
         """
         length = jnp.where(reset, reset_val, cache.length)
         cache = dataclasses.replace(cache, length=length)
@@ -1119,15 +1271,25 @@ class SlotServer:
         logits, new_cache = forward_step(
             params, tokens, cache, self.cfg, n_tokens=n_tok, **kw
         )
-        key, sub = jax.random.split(key)
-        idx = jnp.maximum(n_tok - 1, 0)
-        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        nxt = self._sample(last, sub)
-        nxt = jnp.where(emit, nxt, tokens[:, 0])
-        return nxt, new_cache, key
+        row = jnp.maximum(n_tok - 1, 0)
+        last = jnp.take_along_axis(logits, row[:, None, None], axis=1)[:, 0]
+        tok_s, lp_s = self._sample_emit(last, keys, temp, topk, idx)
+        nxt = jnp.where(emit, tok_s, tokens[:, 0])
+        lp_out = jnp.where(emit, lp_s, lp_vec)
+        fused = jnp.concatenate(
+            [nxt[:, None],
+             lax.bitcast_convert_type(lp_out, jnp.int32)[:, None]],
+            axis=1,
+        )
+        # ``last`` rides out as a device carry: a fork family samples
+        # its siblings' first tokens from the PARENT's exact prompt-end
+        # logits row (bit-identical to the parent's own sample point —
+        # the greedy parity gate's exactness), never re-computing a
+        # written KV row. Fetched never, read only at fork time.
+        return nxt, lp_out, fused, last, new_cache
 
     def _whole_suffix_fn(self, params, rows, slot, n, last, first, start,
-                         cache, tok_vec, key):
+                         cache, tok_vec, keys, temp, topk, idx, lp_vec):
         """One suffix chunk of a whole-admission prefix hit: slot ``slot``
         consumes ``n`` of the ``rows`` (a padded ``(Tq,)`` chunk of its
         prompt) while every other slot rides inert — their parked tokens
@@ -1148,10 +1310,29 @@ class SlotServer:
         reset = one_hot & first
         reset_val = jnp.where(one_hot, start, 0).astype(jnp.int32)
         return self._mixed_fn(params, tokens, n_vec, reset, reset_val,
-                              emit, cache, key)
+                              emit, cache, keys, temp, topk, idx, lp_vec)
+
+    def _sibling_first_fn(self, tok_vec, lp_vec, row, key, temp, topk,
+                          slot):
+        """Park a forked sibling's FIRST token: sample from the parent's
+        stashed prompt-end logits ``row`` under the child's key (branch
+        index folded in at seeding) and write token + logprob into the
+        device vectors — the child then rides the existing ``await``
+        machinery, surfacing at the next batched fetch. Greedy children
+        argmax the identical row, so every sibling's first token is
+        bit-identical to an independent admission's."""
+        tok_s, lp_s = self._sample_emit(
+            row[None], key[None], jnp.reshape(temp, (1,)),
+            jnp.reshape(topk, (1,)), jnp.zeros((1,), jnp.int32),
+        )
+        tok_vec = lax.dynamic_update_index_in_dim(tok_vec, tok_s[0],
+                                                  slot, axis=0)
+        lp_vec = lax.dynamic_update_index_in_dim(lp_vec, lp_s[0],
+                                                 slot, axis=0)
+        return tok_vec, lp_vec
 
     def _spec_step(self, params, mat, tok_vec, use_dev0, n_tok, reset,
-                   reset_val, emit, depth, bits, cache, key):
+                   reset_val, emit, depth, bits, cache):
         """THE verify-tick program (speculate=True): the same mixed-Tq
         step as :meth:`_mixed_fn` plus the three speculative extras —
 
@@ -1184,33 +1365,31 @@ class SlotServer:
         logits, new_cache = forward_step(
             params, tokens, cache, self.cfg, n_tokens=n_tok, **kw
         )
-        key, sub = jax.random.split(key)
         idx = jnp.maximum(n_tok - 1, 0)
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        nxt = self._sample(last, sub)
+        # Speculation is greedy-only (enforced at construction), so the
+        # emit sample is a pure argmax — no key, no logprob tracking.
+        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
         nxt = jnp.where(emit, nxt, tokens[:, 0])
         all_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, Tq)
         # One fused (S, 1+Tq) output = ONE host fetch per tick: column 0
         # is the token vector (the awaits/parked contract), the rest the
         # verify argmax rows.
-        return jnp.concatenate([nxt[:, None], all_tok], axis=1), \
-            new_cache, key
+        return jnp.concatenate([nxt[:, None], all_tok], axis=1), new_cache
 
     def _spec_lin_fn(self, params, mat, tok_vec, use_dev0, n_tok, reset,
-                     reset_val, emit, cache, key):
+                     reset_val, emit, cache):
         """Verify tick with chain drafts only — pure causal, no mask or
         position operands (one program family shared with chunk ticks)."""
         return self._spec_step(params, mat, tok_vec, use_dev0, n_tok,
-                               reset, reset_val, emit, None, None, cache,
-                               key)
+                               reset, reset_val, emit, None, None, cache)
 
     def _spec_tree_fn(self, params, mat, tok_vec, use_dev0, n_tok, reset,
-                      reset_val, emit, depth, bits, cache, key):
+                      reset_val, emit, depth, bits, cache):
         """Verify tick with >= 1 token-tree draft: per-slot depths and
         ancestor masks ride along (SpecInfer, arXiv:2305.09781)."""
         return self._spec_step(params, mat, tok_vec, use_dev0, n_tok,
-                               reset, reset_val, emit, depth, bits, cache,
-                               key)
+                               reset, reset_val, emit, depth, bits, cache)
 
     def _compact_fn(self, cache, start, src, n):
         """Batched commit compaction: move each verifying slot's accepted
@@ -1218,7 +1397,7 @@ class SlotServer:
         slots with n=0 are bit-identically untouched."""
         return compact_decode_window(cache, start, src, n)
 
-    def _prefill_fn(self, params, prompt, plen, key):
+    def _prefill_fn(self, params, prompt, plen, key, temp, topk):
         """Legacy whole-prompt admission: prefill one request into a fresh
         prompt-bucket-sized B=1 cache (NOT a full-capacity one — the
         bucket bounds both the allocation and the attention work).
@@ -1246,7 +1425,11 @@ class SlotServer:
         v = jnp.where(valid, mini.v, 0)
         last = lax.dynamic_index_in_dim(logits, plen - 1, axis=1,
                                         keepdims=False)  # (1, V)
-        tok = self._sample(last, key)[0]
+        tok_s, lp_s = self._sample_emit(
+            last, key[None], jnp.reshape(temp, (1,)),
+            jnp.reshape(topk, (1,)), jnp.zeros((1,), jnp.int32),
+        )
+        tok, lp = tok_s[0], lp_s[0]
         if self.quantize:
             if self._paged:
                 # Per-BLOCK quantization (ISSUE 13): each prompt block's
@@ -1255,12 +1438,12 @@ class SlotServer:
                 kq, vq, ks, vs = quantize_paged_blocks(
                     k, v, self.kv_block, plen
                 )
-                return kq, vq, ks, vs, tok
+                return (kq, vq, ks, vs, tok, lp), last
             qc = quantize_cache(KVCache(k=k, v=v, length=mini.length))
-            return qc.k, qc.v, qc.k_scale, qc.v_scale, tok
-        return k, v, tok
+            return (qc.k, qc.v, qc.k_scale, qc.v_scale, tok, lp), last
+        return (k, v, tok, lp), last
 
-    def _insert_fn(self, cache, tok_vec, slot, payload, plen):
+    def _insert_fn(self, cache, tok_vec, lp_vec, slot, payload, plen):
         """Place a bucket-sized prefilled B=1 cache into slot ``slot`` of
         the batch cache (k/v rows, per-slot length, first token). The
         slot's rows beyond the bucket keep stale bytes from the previous
@@ -1269,9 +1452,10 @@ class SlotServer:
         paged layout the rows scatter through the slot's block table
         (the engine mapped blocks covering ``[0, plen)`` first)."""
         if self.quantize:
-            k_new, v_new, ks_new, vs_new, first = payload
+            k_new, v_new, ks_new, vs_new, first, lp = payload
         else:
-            k_new, v_new, first = payload
+            k_new, v_new, first, lp = payload
+        lp_vec = lax.dynamic_update_index_in_dim(lp_vec, lp, slot, axis=0)
         if self._paged:
             plen_i = jnp.asarray(plen, jnp.int32)
             if self.quantize:
@@ -1285,7 +1469,7 @@ class SlotServer:
             tok_vec = lax.dynamic_update_index_in_dim(
                 tok_vec, first, slot, axis=0
             )
-            return new_cache, tok_vec
+            return new_cache, tok_vec, lp_vec
         put = lambda buf, new: lax.dynamic_update_slice(
             buf, new.astype(buf.dtype), (0, slot, 0, 0, 0)
         )
@@ -1304,7 +1488,7 @@ class SlotServer:
                 k=put(cache.k, k_new), v=put(cache.v, v_new), length=length
             )
         tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot, axis=0)
-        return new_cache, tok_vec
+        return new_cache, tok_vec, lp_vec
 
     def _stage_chunk_fn(self, params, tokens, n_tok, staging, reset,
                         reset_val):
@@ -1321,8 +1505,8 @@ class SlotServer:
         return staging
 
     def _stage_final_fn(self, params, tokens, n_tok, staging, cache,
-                        tok_vec, slot, plen, reset, reset_val, key,
-                        lo=0):
+                        tok_vec, lp_vec, slot, plen, reset, reset_val,
+                        key, temp, topk, lo=0):
         """The final chunk: finish the staged exact prefill, sample the
         first token from the last valid row, mask the stale tail, quantize
         the staged prompt (per-slot frozen channel scales on the
@@ -1343,7 +1527,12 @@ class SlotServer:
         )
         idx = jnp.maximum(n_tok - 1, 0)
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        first = self._sample(last, key)[0]
+        tok_s, lp_s = self._sample_emit(
+            last, key[None], jnp.reshape(temp, (1,)),
+            jnp.reshape(topk, (1,)), jnp.zeros((1,), jnp.int32),
+        )
+        first, lp = tok_s[0], lp_s[0]
+        lp_vec = lax.dynamic_update_index_in_dim(lp_vec, lp, slot, axis=0)
         valid = (
             jnp.arange(self.cache_len, dtype=jnp.int32) < plen
         )[None, None, None, :, None]
@@ -1359,7 +1548,7 @@ class SlotServer:
             )
             tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first,
                                                       slot, axis=0)
-            return staging, new_cache, tok_vec
+            return staging, new_cache, tok_vec, lp_vec, last
         qc = quantize_cache(KVCache(
             k=k_masked,
             v=v_masked,
@@ -1378,7 +1567,7 @@ class SlotServer:
         )
         tok_vec = lax.dynamic_update_index_in_dim(tok_vec, first, slot,
                                                   axis=0)
-        return staging, new_cache, tok_vec
+        return staging, new_cache, tok_vec, lp_vec, last
 
     # -- ingress-facing control (thread-safe) ------------------------------
 
@@ -1400,6 +1589,28 @@ class SlotServer:
         may disconnect after its stream completed)."""
         with self._ctl_lock:
             self._cancel_uids.add(uid)
+
+    def fork(self, uid: int) -> None:
+        """Branch live request ``uid`` mid-generation (any thread).
+
+        Records the uid in the fork mailbox; the tick loop's control
+        sweep applies it — the request's newest branch gets a fresh
+        slot whose block table SHARES every full ancestor block
+        (refcount++, zero KV bytes) with only the partial tail block
+        copied, and continues sampling under its own PRNG key. The
+        branch finishes as one more indexed :class:`RequestResult`
+        under the same uid ("join" = the family's results/callbacks).
+        Scarce slots/blocks defer the fork a couple of sweeps; a uid
+        that is not (or no longer) live ages out as a no-op."""
+        with self._ctl_lock:
+            self._fork_uids.append(uid)
+
+    def _take_forks(self) -> List[int]:
+        """Drain the fork mailbox (loop side), oldest first."""
+        with self._ctl_lock:
+            out = self._fork_uids
+            self._fork_uids = []
+            return out
 
     def request_drain(self) -> None:
         """Begin graceful drain (any thread; e.g. a SIGTERM handler).
@@ -1443,6 +1654,9 @@ class SlotServer:
                                if self._paged else 0),
             "blocks_used": self._pool.used if self._paged else 0,
             "blocks_reserved": self._pool.reserved if self._paged else 0,
+            # CoW-shared fork ancestors still refcounted by some slot
+            # (ISSUE 15) — 0 after a drain, like blocks_private.
+            "blocks_shared": self._pool.shared_count if self._paged else 0,
             "blocks_cached": 0,
             "pins": 0,
         }
@@ -1461,62 +1675,159 @@ class SlotServer:
 
     # -- per-request callbacks (engine thread) -----------------------------
 
-    def _push_token(self, req: Request, tok: int) -> None:
-        if req.on_token is not None:
+    def _deliver_token(self, req: Request, index: int, tok: int) -> None:
+        """Raw token delivery: branch callback when wired (any index),
+        else the legacy single-stream callback for branch 0 only."""
+        cb = req.on_branch_token
+        if cb is not None:
+            try:
+                cb(index, tok)
+            except Exception:
+                log.exception("on_branch_token failed (rid %s)", req.uid)
+            return
+        if index == 0 and req.on_token is not None:
             try:
                 req.on_token(tok)
             except Exception:
                 log.exception("on_token callback failed (rid %s)", req.uid)
 
-    def _notify_finish(self, req: Request, result: RequestResult) -> None:
-        if req.on_finish is not None:
+    def _deliver_finish(self, req: Request, index: int,
+                        result: RequestResult) -> None:
+        cb = req.on_branch_finish
+        if cb is not None:
+            try:
+                cb(index, result)
+            except Exception:
+                log.exception("on_branch_finish failed (rid %s)", req.uid)
+            return
+        if index == 0 and req.on_finish is not None:
             try:
                 req.on_finish(result)
             except Exception:
                 log.exception("on_finish callback failed (rid %s)", req.uid)
+
+    def _push_token(self, req: Request, tok: int, index: int = 0) -> None:
+        fam = self._families.get(req.uid)
+        if fam is not None and fam.best_of:
+            # Server-side selection: nothing streams until the family
+            # joins and _emit_best_of replays the winner.
+            return
+        self._deliver_token(req, index, tok)
+
+    def _notify_finish(self, req: Request, result: RequestResult,
+                       fam: Optional["_ForkFamily"] = None) -> None:
+        fam = fam if fam is not None else self._families.get(req.uid)
+        if fam is not None and fam.best_of:
+            return  # the family join emits the one winner finish
+        self._deliver_finish(req, result.index, result)
 
     def _finish_unadmitted(self, req: Request, tick: int, outcome: str,
                            results: List[RequestResult],
                            visible_at: float, now: float) -> None:
         """Retire a request that never reached a slot (cancelled,
         deadline-expired, or shed while queued; invalid live
-        submission). No engine resources to release — only the result,
-        the outcome counter, and the client callback."""
-        res = RequestResult(
-            uid=req.uid,
-            tokens=[],
-            prompt_len=len(req.prompt),
-            arrival_tick=req.arrival_tick,
-            admit_tick=-1,
-            finish_tick=tick,
-            queue_wait_s=max(now - visible_at, 0.0),
-            completion_s=max(now - visible_at, 0.0),
-            outcome=outcome,
-            ttft_s=0.0,
-        )
-        results.append(res)
-        if outcome in (OUTCOME_DEADLINE, OUTCOME_SHED, OUTCOME_ERROR):
-            # A categorical SLO miss: the system failed to serve it.
-            # (Client cancellations are not the server's miss.)
-            self.slo.observe_miss()
-        if obs.REGISTRY.enabled:
-            _REQUESTS.labels(outcome=outcome).inc()
+        submission). No engine resources to release — only the
+        result(s), the outcome counter, and the client callback. An
+        n/best_of family rejects whole: one result PER requested
+        completion, so a client counting n finishes always converges."""
+        branches = self._branches(req)
+        for index in range(branches):
+            res = RequestResult(
+                uid=req.uid,
+                tokens=[],
+                prompt_len=len(req.prompt),
+                arrival_tick=req.arrival_tick,
+                admit_tick=-1,
+                finish_tick=tick,
+                queue_wait_s=max(now - visible_at, 0.0),
+                completion_s=max(now - visible_at, 0.0),
+                outcome=outcome,
+                ttft_s=0.0,
+                index=index,
+            )
+            results.append(res)
+            if outcome in (OUTCOME_DEADLINE, OUTCOME_SHED, OUTCOME_ERROR):
+                # A categorical SLO miss: the system failed to serve it.
+                # (Client cancellations are not the server's miss.)
+                self.slo.observe_miss()
+            if obs.REGISTRY.enabled:
+                _REQUESTS.labels(outcome=outcome).inc()
+            self._deliver_finish(req, index, res)
         if obs.TRACER.active:
             obs.instant("request_rejected", cat="serving", args={
                 "rid": req.uid, "tick": tick, "outcome": outcome,
-                "queued_s": round(res.queue_wait_s, 6),
+                "branches": branches,
             })
-        self._notify_finish(req, res)
 
     # -- scheduler --------------------------------------------------------
 
     def _free_slots(self) -> List[int]:
         return [i for i, st in enumerate(self._slot_state) if st == "free"]
 
+    @staticmethod
+    def _branches(req: Request) -> int:
+        """How many completions request ``req`` fans out to (ISSUE 15):
+        ``best_of`` branches when server-side selection is on, else
+        ``n`` — always >= 1."""
+        bo = req.best_of if req.best_of is not None else 0
+        return max(int(req.n), int(bo), 1)
+
+    # Overridden to False on engines that cannot expand fork families
+    # (the disaggregated pair's workers — a family would need slots on
+    # both sides of the handoff).
+    _fork_ok = True
+
     def _validate(self, req: Request) -> None:
         plen = len(req.prompt)
         if plen < 1:
             raise ValueError(f"request {req.uid}: empty prompt")
+        if req.temperature is not None and req.temperature < 0:
+            raise ValueError(
+                f"request {req.uid}: temperature must be >= 0"
+            )
+        if req.top_k is not None and req.top_k < 0:
+            raise ValueError(f"request {req.uid}: top_k must be >= 0")
+        if self._speculate and (req.temperature or 0) > 0:
+            raise ValueError(
+                f"request {req.uid}: sampled decoding (temperature > 0) "
+                f"is not servable by a speculate=True engine (the "
+                f"greedy accept rule is what makes speculation exact)"
+            )
+        if req.n < 1:
+            raise ValueError(f"request {req.uid}: n must be >= 1")
+        if req.best_of is not None and req.best_of > 1 and req.n != 1:
+            raise ValueError(
+                f"request {req.uid}: best_of runs server-side selection "
+                f"and streams ONE winner — it requires n == 1"
+            )
+        if req.fork_at is not None and req.fork_at < 1:
+            raise ValueError(f"request {req.uid}: fork_at must be >= 1")
+        branches = self._branches(req)
+        if branches > 1:
+            if not self._paged:
+                raise ValueError(
+                    f"request {req.uid}: n/best_of > 1 forks over "
+                    f"shared KV blocks — it requires kv_layout='paged'"
+                )
+            if self._speculate:
+                raise ValueError(
+                    f"request {req.uid}: n/best_of > 1 is not supported "
+                    f"with speculate=True (fork branches are sampled; "
+                    f"speculation is greedy-only)"
+                )
+            if not self._fork_ok:
+                raise ValueError(
+                    f"request {req.uid}: n/best_of > 1 is not supported "
+                    f"on this engine (disaggregated workers cannot "
+                    f"expand fork families; mid-generation fork(uid) "
+                    f"on the decode pool still works)"
+                )
+            if branches > self.slots:
+                raise ValueError(
+                    f"request {req.uid}: {branches} parallel branches "
+                    f"exceed the engine's {self.slots} slots (the whole "
+                    f"family decodes concurrently)"
+                )
         if req.max_new_tokens < 1:
             # The prefill itself samples one token, so a zero budget
             # is unservable — same contract as generate().
@@ -1543,11 +1854,27 @@ class SlotServer:
                     f"but the --kv-blocks pool holds {self.kv_blocks}; "
                     f"raise --kv-blocks or shrink the request"
                 )
+            branches = self._branches(req)
+            if branches > 1:
+                # Each sibling's worst case is its NEW blocks only —
+                # everything below the fork point is shared (the CoW
+                # economics this subsystem exists for).
+                fam = need + (branches - 1) * (
+                    need - (plen - 1) // self.kv_block
+                )
+                if fam > self.kv_blocks:
+                    raise ValueError(
+                        f"request {req.uid}: a {branches}-branch family "
+                        f"worst-cases at {fam} KV blocks (shared "
+                        f"ancestors counted once) but the pool holds "
+                        f"{self.kv_blocks}; raise --kv-blocks or shrink "
+                        f"the request"
+                    )
 
     # -- paged-pool bookkeeping -------------------------------------------
 
     def _paged_reserve(self, req: Request) -> Optional[Tuple[int, List[Any],
-                                                             int]]:
+                                                             int, int]]:
         """Match (pinning the path) + reserve the admission's worst-case
         private blocks; ``None`` defers the admission — the request waits
         in the queue until retires/evictions free blocks. The prefix
@@ -1556,7 +1883,15 @@ class SlotServer:
         included now that per-block scales make its blocks shareable);
         a matched node sitting on the HOST tier still costs one
         reservation, because restoring it allocates a fresh device block
-        (the restore consumes exactly that reservation in _paged_hit)."""
+        (the restore consumes exactly that reservation in _paged_hit).
+
+        A fork family (``n``/``best_of`` > 1, ISSUE 15) reserves
+        ATOMICALLY: the parent's blocks plus each sibling's worst-case
+        NEW blocks (its total minus the full ancestors it will share) —
+        so sibling forks later never fail, and two half-reserved
+        families can never deadlock the pool against each other. The
+        family extra is returned separately and held by the family
+        until the forks consume it."""
         total = -(-(len(req.prompt) + req.max_new_tokens) // self.kv_block)
         matched, nodes = 0, []
         if self._paged_prefix:
@@ -1565,13 +1900,18 @@ class SlotServer:
             )
         dev_matched = sum(1 for n in nodes if n.tier == TIER_DEVICE)
         needed = total - dev_matched
-        if not self._pool.reserve(needed):
+        branches = self._branches(req)
+        fam_extra = 0
+        if branches > 1:
+            sib = total - (len(req.prompt) - 1) // self.kv_block
+            fam_extra = (branches - 1) * sib
+        if not self._pool.reserve(needed + fam_extra):
             if nodes:
                 self._prefix.release(nodes)
             return None
         if self._paged_prefix:
             self._prefix.record_match(matched)
-        return matched, nodes, needed
+        return matched, nodes, needed, fam_extra
 
     def _ensure_blocks(self, slot: int, tokens_needed: int) -> None:
         """Map physical blocks covering ``[0, tokens_needed)`` tokens of
@@ -1658,7 +1998,7 @@ class SlotServer:
 
     def _admit(self, req: Request, slot: int, tick: int,
                visible_at: float,
-               resv: Optional[Tuple[int, List[Any], int]] = None) -> float:
+               resv: Optional[Tuple[int, List[Any], int, int]] = None) -> float:
         # Queue wait ends the moment the scheduler takes the request —
         # BEFORE any prefill work runs (prefill, including a first-bucket
         # jit compile, is service time, not queueing).
@@ -1672,6 +2012,21 @@ class SlotServer:
         # previous occupant's
         self._slot_wait[slot] = waited
         self._chunk_k[slot] = 0
+        # Sampling state (ISSUE 15): per-slot temperature/top-k (engine
+        # defaults unless the request overrides) and the request's PRNG
+        # key — fold_in(base, seed-or-uid) at branch 0. Pure host/np
+        # writes plus one tiny key dispatch; the vectors ride every
+        # dispatch as operands.
+        self._slot_index[slot] = 0
+        self._slot_cum_lp[slot] = 0.0
+        self._slot_shared[slot] = set()
+        self._temp_np[slot] = (self.temperature if req.temperature is None
+                               else req.temperature)
+        self._topk_np[slot] = (self.top_k if req.top_k is None
+                               else req.top_k)
+        salt = (req.seed if req.seed is not None else req.uid) & 0x7FFFFFFF
+        self._keys = self._seed_key(self._keys, jnp.int32(slot),
+                                    jnp.int32(salt), jnp.int32(0))
         self.slo.observe_queue_wait(waited)
         # Prefix reuse happens FIRST: the matched length decides how much
         # prompt is left to prefill (and rides the request span below).
@@ -1684,7 +2039,7 @@ class SlotServer:
             # The reservation was taken (and the radix path pinned) by
             # _paged_reserve in the admit loop — here the slot takes
             # ownership of both.
-            _, _, needed = resv
+            _, _, needed, _ = resv
             self._slot_reserve[slot] = needed
             self._slot_private[slot] = set()
             self._slot_nblocks[slot] = 0
@@ -1812,7 +2167,7 @@ class SlotServer:
         return len(demoted)
 
     def _paged_hit(self, req: Request, slot: int, tick: int,
-                   resv: Tuple[int, List[Any], int]) -> int:
+                   resv: Tuple[int, List[Any], int, int]) -> int:
         """The reference-in-place hit (paged serving): write the matched
         path's pool ids into the slot's table row and set the prefill
         start — pure host bookkeeping, ZERO device KV bytes moved on the
@@ -1825,7 +2180,7 @@ class SlotServer:
         blocks into the staging cache — the suffix's exact staged
         prefill attends them as activations-grade rows — and THOSE are
         the bytes the instant reports for int8."""
-        matched, nodes, _ = resv
+        matched, nodes, _, _ = resv
         self._slot_nodes[slot] = nodes
         if not matched:
             return 0
@@ -1962,12 +2317,17 @@ class SlotServer:
                     pad[0, n:] = 0
                 pad[0, :n] = rows
                 self._sync_table()
-                self.tok, self.cache, self._key = self._whole_suffix(
-                    self.params, jnp.asarray(pad[0]), jnp.int32(slot),
-                    jnp.int32(n), jnp.asarray(last), jnp.asarray(first),
-                    jnp.int32(self._prefill_start[slot]), self.cache,
-                    self.tok, self._key,
-                )
+                self.tok, self._lp, _, last_dev, \
+                    self.cache = self._whole_suffix(
+                        self.params, jnp.asarray(pad[0]), jnp.int32(slot),
+                        jnp.int32(n), jnp.asarray(last), jnp.asarray(first),
+                        jnp.int32(self._prefill_start[slot]), self.cache,
+                        self.tok, self._keys, jnp.asarray(self._temp_np),
+                        jnp.asarray(self._topk_np),
+                        jnp.zeros((self.slots,), jnp.int32), self._lp,
+                    )
+                if last and req.uid in self._families:
+                    self._slot_logits[slot] = last_dev[slot]
                 pos += n
             self._publish_prefix(slot)
             return
@@ -1983,12 +2343,16 @@ class SlotServer:
         else:
             padded[0, plen:] = 0
         padded[0, :plen] = np.asarray(req.prompt, np.int32)
-        self._key, sub = jax.random.split(self._key)
-        payload = self._prefill(self.params, jnp.asarray(padded),
-                                jnp.int32(plen), sub)
+        payload, last_row = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(plen),
+            self._keys[slot], jnp.float32(self._temp_np[slot]),
+            jnp.int32(self._topk_np[slot]),
+        )
+        if req.uid in self._families:
+            self._slot_logits[slot] = last_row[0]
         self._sync_table()
-        self.cache, self.tok = self._insert(
-            self.cache, self.tok, jnp.int32(slot), payload, plen
+        self.cache, self.tok, self._lp = self._insert(
+            self.cache, self.tok, self._lp, jnp.int32(slot), payload, plen
         )
         if self._prefix is not None:
             self._publish_prefix(slot)
@@ -2010,6 +2374,368 @@ class SlotServer:
             budget -= n
             plan.append((slot, n, pos + n == plen))
         return plan
+
+    # -- copy-on-write forking (ISSUE 15) ---------------------------------
+
+    def _admit_family(self, req: Request, parent_slot: int,
+                      free: List[int], resv) -> None:
+        """Reserve the rest of an n>1 / best-of-n family at admission:
+        one ``fpend`` slot per sibling (taken NOW so two half-admitted
+        families can never deadlock waiting on each other's slots) and
+        the block hold ``_paged_reserve`` already took. The siblings
+        fork in the awaits pass, the tick the parent's first token
+        lands."""
+        branches = self._branches(req)
+        _, _, _, fam_extra = resv
+        sibs = [free.pop(0) for _ in range(branches - 1)]
+        for s in sibs:
+            self._slot_state[s] = "fpend"
+        self._families[req.uid] = _ForkFamily(
+            req=req, parent_slot=parent_slot, sibling_slots=sibs,
+            sib_reserve=fam_extra // (branches - 1), hold=fam_extra,
+            best_of=bool(req.best_of and req.best_of > 1),
+            branches=branches,
+        )
+        self._uid_next_index[req.uid] = branches
+
+    def _fork_family(self, fam: _ForkFamily, parent_slot: int,
+                     tick: int, now2: float, results) -> int:
+        """Fork every reserved sibling off the freshly-live parent —
+        called from the awaits pass BEFORE the parent's EOS check, so
+        even a one-token parent yields n independent samples. Each
+        sibling's block budget moves from the family hold to the slot's
+        reservation ledger; each sibling's first token (sampled by
+        ``_sibling_first`` from the parent's exact prompt-end logits)
+        surfaces through ONE extra batched fetch — a per-family
+        admission-time cost, not a per-tick one — and the sibling goes
+        live exactly like a final-chunk slot, EOS/budget checks
+        included. Returns the number of first tokens emitted."""
+        req = fam.req
+        for j, child in enumerate(fam.sibling_slots):
+            self._fork_child(parent_slot, child, 1 + j, [],
+                             fam.sib_reserve, tick)
+            fam.hold -= fam.sib_reserve
+        fam.forked = True
+        self._slot_logits[parent_slot] = None
+        tok_h = np.asarray(self.tok)
+        lp_h = np.asarray(self._lp)
+        th = np.array(self._tok_host)
+        emitted = 0
+        for j, child in enumerate(fam.sibling_slots):
+            t0 = int(tok_h[child])
+            th[child] = t0
+            self._slot_tokens[child] = [t0]
+            self._slot_cum_lp[child] = float(lp_h[child])
+            self._slot_state[child] = "live"
+            self._slot_clen[child] = len(self._prompt_np[child])
+            self._push_token(req, t0, 1 + j)
+            _, vis = self._slot_admit[child]
+            self._slot_ttft[child] = max(now2 - vis, 0.0)
+            self._last_tok_t[child] = now2
+            emitted += 1
+            self.slo.observe_ttft(self._slot_ttft[child])
+            if obs.REGISTRY.enabled:
+                _TOKENS.inc()
+                _TTFT.observe(self._slot_ttft[child])
+            if obs.TRACER.active:
+                obs.instant("first_token", cat="serving", args={
+                    "rid": req.uid, "slot": child, "tick": tick,
+                    "index": 1 + j,
+                    "ttft_s": round(self._slot_ttft[child], 6),
+                })
+            if req.eos_id is not None and t0 == req.eos_id:
+                self._retire(child, tick, OUTCOME_EOS, results)
+            elif req.max_new_tokens <= 1:
+                self._retire(child, tick, OUTCOME_BUDGET, results)
+        self._tok_host = th
+        return emitted
+
+    def _fork_child(self, parent_slot: int, child_slot: int, index: int,
+                    tokens_prefix: List[int], resv_blocks: int,
+                    tick: int) -> None:
+        """THE copy-on-write fork (vLLM's fork over PagedAttention block
+        tables, arXiv:2309.06180): give ``child_slot`` the parent's
+        history up to the fork point by SHARING every full ancestor
+        block — radix-cached ancestors get one more pin, parent-private
+        ones refcount into the allocator's ``shared`` state — and
+        copying ONLY the partial tail block (one jitted dispatch; none
+        when the fork point is block-aligned). Two flavors, both exact:
+
+        - admission sibling (``tokens_prefix == []``): fork point = the
+          prompt end. The child's first token samples from the parent's
+          STASHED prompt-end logits under the child's own key (branch
+          ``index`` folded in) — bit-identical inputs to the parent's
+          own sample, so greedy siblings match an independent admission
+          token-for-token — and parks in the device vectors; the child
+          rides the existing ``await`` machinery from there. No KV row
+          is ever recomputed.
+        - mid-generation branch (``tokens_prefix`` = the parent's
+          emitted stream): fork point = the last COMMITTED row; the
+          shared tip token is re-consumed by parent and child alike,
+          each writing its own FRESH copy of that row, and the child's
+          next sample (its own key, stream index ``len(tokens_prefix)``)
+          diverges.
+
+        ``resv_blocks`` is the child's worst-case NEW-block budget,
+        already reserved by the caller."""
+        req = self._slot_req[parent_slot]
+        prompt = self._prompt_np[parent_slot]
+        plen = len(prompt)
+        if tokens_prefix:
+            tip = int(tokens_prefix[-1])
+            L = plen + len(tokens_prefix) - 1
+        else:
+            tip = 0  # placeholder; _sibling_first parks the real token
+            L = plen
+        B = self.kv_block
+        nshare = L // B
+        # Shared ancestors, radix tier first: the child pins the
+        # parent's matched/published path like a second admission.
+        pnodes = self._slot_nodes[parent_slot]
+        n_pin = min(nshare, len(pnodes))
+        if n_pin:
+            child_nodes = self._prefix.repin(pnodes[:n_pin])
+            self._slot_nodes[child_slot] = child_nodes
+        else:
+            self._slot_nodes[child_slot] = []
+        # ...then CoW-refcount the rest (parent-private decode blocks,
+        # or unpublished prompt blocks when the prefix cache is off).
+        share_bids = [int(self._host_table[parent_slot, j])
+                      for j in range(n_pin, nshare)]
+        self._slot_shared[child_slot] = set(
+            self._pool.fork_shared(share_bids)
+        )
+        for bid in share_bids:
+            self._slot_private[parent_slot].discard(bid)
+            self._slot_shared[parent_slot].add(bid)
+        self._host_table[child_slot, :nshare] = \
+            self._host_table[parent_slot, :nshare]
+        self._host_table[child_slot, nshare:] = 0
+        self._slot_reserve[child_slot] = resv_blocks
+        self._slot_private[child_slot] = set()
+        self._slot_nblocks[child_slot] = nshare
+        need_copy = (L % B) != 0
+        if need_copy:
+            src = int(self._host_table[parent_slot, nshare])
+            assert self._slot_reserve[child_slot] > 0, (
+                f"fork into slot {child_slot} outgrew its reservation"
+            )
+            dst = self._pool.alloc()
+            self._slot_reserve[child_slot] -= 1
+            self._host_table[child_slot, nshare] = dst
+            self._slot_private[child_slot].add(dst)
+            self._slot_nblocks[child_slot] = nshare + 1
+        else:
+            src = dst = 0  # block-aligned fork: the copy degenerates to
+            # a self-write and the program only parks the tip
+        self._table_dirty = True
+        self.cache, self.tok = self._fork_copy(
+            self.cache, self.tok, jnp.int32(src), jnp.int32(dst),
+            jnp.int32(child_slot), jnp.int32(tip),
+        )
+        salt = (req.seed if req.seed is not None else req.uid) & 0x7FFFFFFF
+        self._keys = self._seed_key(self._keys, jnp.int32(child_slot),
+                                    jnp.int32(salt), jnp.int32(index))
+        # Host mirrors: the child is an ordinary live slot from here on.
+        self._slot_req[child_slot] = req
+        self._slot_index[child_slot] = index
+        self._slot_tokens[child_slot] = list(tokens_prefix)
+        self._prompt_np[child_slot] = prompt
+        self._slot_admit[child_slot] = self._slot_admit[parent_slot]
+        self._slot_wait[child_slot] = self._slot_wait[parent_slot]
+        self._slot_ttft[child_slot] = (
+            self._slot_ttft[parent_slot] if tokens_prefix else 0.0
+        )
+        self._slot_max_tbt[child_slot] = 0.0
+        self._slot_prefix_hit[child_slot] = nshare * B
+        self._slot_cum_lp[child_slot] = (
+            self._slot_cum_lp[parent_slot] if tokens_prefix else 0.0
+        )
+        self._temp_np[child_slot] = self._temp_np[parent_slot]
+        self._topk_np[child_slot] = self._topk_np[parent_slot]
+        self._prefill_start[child_slot] = 0
+        self._chunk_k[child_slot] = 0
+        self._slot_clen[child_slot] = L
+        self._live_reset[child_slot] = L
+        if tokens_prefix:
+            # Mid-generation branch: an ordinary live slot whose next
+            # tick re-consumes the shared tip (a fresh row for each
+            # branch) — park the tip host- and device-side.
+            self._slot_state[child_slot] = "live"
+            self._last_tok_t[child_slot] = self._last_tok_t[parent_slot]
+            th = np.array(self._tok_host)  # the fetch view is read-only
+            th[child_slot] = tip
+            self._tok_host = th
+        else:
+            # Admission sibling: draw the child's own first token from
+            # the parent's stashed prompt-end logits (exactly what an
+            # independent admission's prefill would sample from) and
+            # ride the await machinery — its TTFT closes at the next
+            # batched fetch, like any final-chunk slot.
+            row = self._slot_logits[parent_slot]
+            assert row is not None, "fork family lost its logits stash"
+            self.tok, self._lp = self._sibling_first(
+                self.tok, self._lp, row, self._keys[child_slot],
+                jnp.float32(self._temp_np[child_slot]),
+                jnp.int32(self._topk_np[child_slot]),
+                jnp.int32(child_slot),
+            )
+            self._slot_state[child_slot] = "await"
+            self._last_tok_t[child_slot] = self._last_tok_t[parent_slot]
+        self._slot_span[child_slot] = obs.span(
+            f"request:{req.uid}", cat="serving",
+            args=None if not obs.TRACER.active else {
+                "rid": req.uid, "slot": child_slot, "admit_tick": tick,
+                "prompt_len": len(prompt), "index": index,
+                "fork_of_slot": parent_slot,
+            },
+        )
+        self._forks_life += 1
+        self._fork_shared_life += nshare
+        self._tick_forks += 1
+        self._tick_fork_shared += nshare
+        if obs.REGISTRY.enabled:
+            _FORKS.inc()
+            if nshare:
+                _FORK_SHARED.inc(nshare)
+        if obs.TRACER.active:
+            obs.instant("fork", cat="serving", args={
+                "rid": req.uid, "tick": tick,
+                "parent_slot": parent_slot, "child_slot": child_slot,
+                "index": index, "shared_blocks": nshare,
+                "copied_blocks": int(need_copy),
+                "at_tokens": len(tokens_prefix),
+            })
+
+    def _fork_live(self, uid: int, tick: int,
+                   pend_uids: Set[int]) -> str:
+        """One mailboxed fork(uid): branch the request's lowest-index
+        live slot onto a free slot. Returns ``"done"`` (forked, or a
+        no-op for an unknown/finished uid), ``"wait"`` (the request
+        exists but is not live yet — queued/prefilling/awaiting; the
+        carry keeps the fork pending without burning retries), or
+        ``"retry"`` (slot/block scarcity — bounded retries, then the
+        fork expires)."""
+        if self._speculate or not self._paged:
+            log.warning(
+                "fork(%d) ignored: forking needs a paged, "
+                "non-speculative engine", uid,
+            )
+            return "done"
+        parent = None
+        for i, rq in enumerate(self._slot_req):
+            if rq is None or rq.uid != uid:
+                continue
+            if self._slot_state[i] == "live":
+                if parent is None \
+                        or self._slot_index[i] < self._slot_index[parent]:
+                    parent = i
+            else:
+                return "wait"  # still prefilling/awaiting — not yet
+                # forkable; the carry holds until it goes live
+        if parent is None:
+            return "done" if uid not in pend_uids else "wait"
+        req = self._slot_req[parent]
+        toks = self._slot_tokens[parent]
+        if len(toks) >= req.max_new_tokens:
+            return "done"  # retiring this tick; nothing left to branch
+        free = self._free_slots()
+        if not free:
+            return "retry"
+        L = len(self._prompt_np[parent]) + max(len(toks) - 1, 0)
+        need = -(-(len(req.prompt) + req.max_new_tokens)
+                 // self.kv_block) - L // self.kv_block
+        if not self._pool.reserve(need):
+            return "retry"
+        idx = self._uid_next_index.get(uid, self._branches(req))
+        self._uid_next_index[uid] = idx + 1
+        self._fork_child(parent, free[0], idx, list(toks), need, tick)
+        return "done"
+
+    def _apply_forks(self, forks: List[int], tick: int,
+                     pending) -> None:
+        """The control sweep's fork arc: apply mailboxed fork(uid)s and
+        re-attempt deferred ones. A fork whose request exists but is
+        not live yet (queued / prefilling — "wait") stays carried at
+        full TTL until the request goes live; slot/block scarcity
+        ("retry") burns one of 3 retries per sweep, then the fork
+        expires; genuinely unknown uids age out as no-ops."""
+        for uid in forks:
+            if uid not in self._fork_carry:
+                self._fork_carry[uid] = 3
+        pend_uids = {r.uid for r in pending}
+        for uid in list(self._fork_carry):
+            verdict = self._fork_live(uid, tick, pend_uids)
+            if verdict == "done":
+                self._fork_carry.pop(uid, None)
+            elif verdict == "wait":
+                self._fork_carry[uid] = 3  # still coming; keep waiting
+            else:
+                self._fork_carry[uid] -= 1
+                if self._fork_carry[uid] <= 0:
+                    del self._fork_carry[uid]
+                    log.warning(
+                        "fork(%d) expired unserved (slots/blocks "
+                        "stayed scarce)", uid,
+                    )
+
+    def _family_branch_done(self, fam: _ForkFamily,
+                            result: RequestResult) -> None:
+        """A branch retired: collect it; the LAST branch completes the
+        family ('join') — best-of-n selects and streams its winner."""
+        fam.done.append(result)
+        if len(fam.done) >= fam.branches:
+            self._families.pop(fam.req.uid, None)
+            if fam.best_of:
+                self._emit_best_of(fam)
+
+    def _cancel_unforked(self, fam: _ForkFamily, parent_result:
+                         RequestResult, tick: int, results) -> None:
+        """The parent retired BEFORE its first token (cancel/deadline
+        mid-prefill): the siblings never forked — free their fpend
+        slots, return the family's block hold, and finish each sibling
+        unserved with the parent's outcome (one result per requested
+        completion, so clients counting n finishes always converge)."""
+        if fam.hold:
+            self._pool.unreserve(fam.hold)
+            fam.hold = 0
+        for j, s in enumerate(fam.sibling_slots):
+            self._slot_state[s] = "free"
+            res = dataclasses.replace(
+                parent_result, index=1 + j, tokens=[], cum_logprob=0.0,
+                ttft_s=0.0,
+            )
+            results.append(res)
+            fam.done.append(res)
+            if parent_result.outcome in (OUTCOME_DEADLINE, OUTCOME_SHED,
+                                         OUTCOME_ERROR):
+                self.slo.observe_miss()
+            if obs.REGISTRY.enabled:
+                _REQUESTS.labels(outcome=res.outcome).inc()
+            self._notify_finish(fam.req, res, fam)
+        fam.sibling_slots = []
+
+    def _emit_best_of(self, fam: _ForkFamily) -> None:
+        """Best-of-n join: pick the winner by cumulative logprob (ties
+        break to the lowest branch index) among cleanly finished
+        branches — every branch failed means the parent's result stands
+        — and stream it NOW as index 0 (per-branch streaming was held
+        back; the winner was unknowable until the family drained)."""
+        req = fam.req
+        happy = [r for r in fam.done
+                 if r.outcome in (OUTCOME_EOS, OUTCOME_BUDGET)]
+        pool = happy or fam.done
+        winner = max(pool, key=lambda r: (r.cum_logprob, -r.index))
+        if obs.TRACER.active:
+            obs.instant("best_of_selected", cat="serving", args={
+                "rid": req.uid, "index": winner.index,
+                "cum_logprob": round(winner.cum_logprob, 6),
+                "branches": len(fam.done),
+            })
+        out = dataclasses.replace(winner, index=0)
+        for t in winner.tokens:
+            self._deliver_token(req, 0, t)
+        self._deliver_finish(req, 0, out)
 
     # -- speculation (ISSUE 8) --------------------------------------------
 
@@ -2245,13 +2971,17 @@ class SlotServer:
             # the slot — its blocks must all be mapped first.
             self._ensure_blocks(slot, plen)
             self._sync_table()
-            self._key, sub = jax.random.split(self._key)
-            self._staging, self.cache, self.tok = self._stage_final(
-                self.params, jnp.asarray(mat), n_vec, self._staging,
-                self.cache, self.tok, jnp.int32(slot), jnp.int32(plen),
-                reset, reset_val, sub,
-                jnp.int32(self._prefill_start[slot]),
-            )
+            self._staging, self.cache, self.tok, self._lp, \
+                last_row = self._stage_final(
+                    self.params, jnp.asarray(mat), n_vec, self._staging,
+                    self.cache, self.tok, self._lp, jnp.int32(slot),
+                    jnp.int32(plen), reset, reset_val,
+                    self._keys[slot], jnp.float32(self._temp_np[slot]),
+                    jnp.int32(self._topk_np[slot]),
+                    jnp.int32(self._prefill_start[slot]),
+                )
+            if self._slot_req[slot].uid in self._families:
+                self._slot_logits[slot] = last_row[0]
             # The staging cache now holds the prompt's EXACT rows (the
             # quantized copy went into the slot) — publish before the
             # next prompt overwrites them.
@@ -2285,6 +3015,8 @@ class SlotServer:
             outcome=outcome,
             ttft_s=self._slot_ttft[slot],
             prefix_hit_tokens=self._slot_prefix_hit[slot],
+            index=self._slot_index[slot],
+            cum_logprob=self._slot_cum_lp[slot],
         )
         results.append(result)
         if outcome in (OUTCOME_EOS, OUTCOME_BUDGET):
@@ -2318,6 +3050,7 @@ class SlotServer:
         self._slot_tokens[slot] = []
         self._slot_state[slot] = "free"
         self._prompt_np[slot] = None
+        self._slot_logits[slot] = None
         if self._prefix is not None and self._slot_nodes[slot]:
             # The request's pinned prefix path becomes evictable.
             self._prefix.release(self._slot_nodes[slot])
@@ -2330,6 +3063,13 @@ class SlotServer:
             for bid in self._slot_private[slot]:
                 self._pool.free_private(bid)
             self._slot_private[slot] = set()
+            # CoW-shared fork ancestors (ISSUE 15): this owner's
+            # refcount drops on EVERY exit arc; the last branch's
+            # release frees the block.
+            for bid in self._slot_shared[slot]:
+                self._pool.release_shared(bid)
+            self._slot_shared[slot] = set()
+            self._live_reset.pop(slot, None)
             if self._slot_reserve[slot]:
                 self._pool.unreserve(self._slot_reserve[slot])
                 self._slot_reserve[slot] = 0
@@ -2342,7 +3082,20 @@ class SlotServer:
             self._pool.gen += 1
         if obs.REGISTRY.enabled:
             _REQUESTS.labels(outcome=outcome).inc()
-        self._notify_finish(req, result)
+        # Fork-family join bookkeeping (ISSUE 15): a parent retiring
+        # before its first token takes its unforked siblings with it;
+        # the per-branch finish is (maybe) delivered, then the family
+        # collects the branch — the LAST one joins (best-of-n selects
+        # and streams its winner there).
+        fam = self._families.get(req.uid)
+        if fam is not None and slot == fam.parent_slot and not fam.forked:
+            self._cancel_unforked(fam, result, tick, results)
+        self._notify_finish(req, result, fam)
+        if fam is not None:
+            self._family_branch_done(fam, result)
+        if not any(rq is not None and rq.uid == req.uid
+                   for rq in self._slot_req):
+            self._uid_next_index.pop(req.uid, None)
 
     def serve(self, requests: Union[Sequence[Request], RequestSource],
               max_ticks: Optional[int] = None) -> ServeReport:
@@ -2389,6 +3142,7 @@ class SlotServer:
         hit_bytes0 = self._hit_bytes_moved
         spec0 = (self._spec_proposed, self._spec_accepted,
                  self._spec_ticks, self._spec_verifies)
+        fork0 = (self._forks_life, self._fork_shared_life)
         if self._paged:
             self._peak_blocks_used = self._pool.used
             self._defer_gen = -1  # stale latch must not defer a fresh run
@@ -2411,6 +3165,8 @@ class SlotServer:
                 self._tick_cancelled = 0
                 self._tick_deadline = 0
                 self._tick_shed = 0
+                self._tick_forks = 0
+                self._tick_fork_shared = 0
 
                 # Ingest newly visible requests. A live source's invalid
                 # request must not kill the loop serving everyone else —
@@ -2515,6 +3271,15 @@ class SlotServer:
                         )
                     # lint: mirror[drain-shed] end
 
+                # Copy-on-write fork arc (ISSUE 15): mailboxed
+                # fork(uid)s branch live requests onto free slots
+                # (deferred ones retry from the carry for a few sweeps).
+                # lint: mirror[fork] begin
+                forks = self._take_forks()
+                if forks or self._fork_carry:
+                    self._apply_forks(forks, tick, pending)
+                # lint: mirror[fork] end
+
                 # Admit: oldest visible request per free slot. Chunked
                 # admission is pure bookkeeping (the chunks run inside the
                 # tick); the staged (quantized) variant holds one prompt in
@@ -2522,6 +3287,14 @@ class SlotServer:
                 free = self._free_slots()
                 while free and pending:
                     if self._staged_prefill and self._prefill_fifo:
+                        break
+                    # An n>1 / best-of-n family admits ATOMICALLY: the
+                    # parent's slot plus one fpend slot per sibling
+                    # (FIFO — the family waits rather than skip-ahead),
+                    # so two half-admitted families can never deadlock
+                    # each other's slots.
+                    branches = self._branches(pending[0])
+                    if branches > len(free):
                         break
                     resv = None
                     if self._paged:
@@ -2544,6 +3317,11 @@ class SlotServer:
                     req = pending.popleft()
                     slot = free.pop(0)
                     vis = visible_wall.pop(req.uid, now)
+                    if branches > 1:
+                        # The family exists BEFORE the admission runs:
+                        # whole-admission prefill stashes the family's
+                        # prompt-end logits synchronously inside _admit.
+                        self._admit_family(req, slot, free, resv)
                     self._admit(req, slot, tick, vis, resv)
                 queue_depth = len(pending)  # visible but still unadmitted
 
@@ -2628,6 +3406,7 @@ class SlotServer:
                     stepped = False
                     spec_plan: Dict[int, PackedSpec] = {}
                     all_tok_dev = None
+                    fused_dev = None
                     spec_width = 0
                     if self._speculate and live_idx:
                         # Draft-and-verify (ISSUE 8): every live slot's
@@ -2729,17 +3508,14 @@ class SlotServer:
                                 r = pack.rows
                                 depth_m[i, :r] = pack.depth
                                 bits_m[i, :r, :r] = pack.anc
-                            all_tok_dev, self.cache, \
-                                self._key = self._spec_tree(
-                                    *args, jnp.asarray(depth_m),
-                                    jnp.asarray(bits_m), self.cache,
-                                    self._key,
-                                )
+                            all_tok_dev, self.cache = self._spec_tree(
+                                *args, jnp.asarray(depth_m),
+                                jnp.asarray(bits_m), self.cache,
+                            )
                         else:
-                            all_tok_dev, self.cache, \
-                                self._key = self._spec_lin(
-                                    *args, self.cache, self._key
-                                )
+                            all_tok_dev, self.cache = self._spec_lin(
+                                *args, self.cache
+                            )
                         self.tok = all_tok_dev[:, 0]
                         stepped = True
                         if self._prefix is not None:
@@ -2765,6 +3541,15 @@ class SlotServer:
                             mat[i, 0] = self._tok_host[i]
                             n_vec[i] = 1
                             emit[i] = True
+                        # Freshly forked children (ISSUE 15): their one
+                        # device-length reset to the fork point.
+                        for i in list(self._live_reset):
+                            # Applied only once the slot is LIVE — an
+                            # awaiting sibling keeps its pending reset
+                            # until its first consuming tick.
+                            if self._slot_state[i] == "live":
+                                reset[i] = True
+                                reset_val[i] = self._live_reset.pop(i)
                         for slot, n, last in plan:
                             self._ensure_blocks(
                                 slot, self._prefill_pos[slot] + n
@@ -2775,14 +3560,28 @@ class SlotServer:
                             reset[slot] = first
                             reset_val[slot] = self._prefill_start[slot]
                             emit[slot] = last
-                        self._sync_table()
-                        self.tok, self.cache, self._key = self._mixed(
-                            self.params, jnp.asarray(mat),
-                            jnp.asarray(n_vec), jnp.asarray(reset),
-                            jnp.asarray(reset_val),
-                            jnp.asarray(emit), self.cache, self._key,
+                        sidx = np.asarray(
+                            [len(t) for t in self._slot_tokens], np.int32
                         )
+                        self._sync_table()
+                        self.tok, self._lp, fused_dev, last_dev, \
+                            self.cache = self._mixed(
+                                self.params, jnp.asarray(mat),
+                                jnp.asarray(n_vec), jnp.asarray(reset),
+                                jnp.asarray(reset_val),
+                                jnp.asarray(emit), self.cache,
+                                self._keys, jnp.asarray(self._temp_np),
+                                jnp.asarray(self._topk_np),
+                                jnp.asarray(sidx), self._lp,
+                            )
                         stepped = True
+                        for slot, n, last in plan:
+                            # Stash prompt-end logits for slots whose
+                            # fork family expands at this tick's awaits
+                            # pass (ISSUE 15).
+                            if last and self._slot_req[slot].uid \
+                                    in self._families:
+                                self._slot_logits[slot] = last_dev[slot]
                         if self._prefix is not None:
                             # Final chunks just completed their prompts in
                             # the batch cache — publish the new blocks
@@ -2797,21 +3596,37 @@ class SlotServer:
                         # emit=False).
                         n_vec = np.zeros((self.slots,), np.int32)
                         emit = np.zeros((self.slots,), bool)
+                        reset = np.zeros((self.slots,), bool)
+                        reset_val = np.zeros((self.slots,), np.int32)
                         n_vec[live_idx] = 1
                         emit[live_idx] = True
+                        for i in list(self._live_reset):
+                            # A forked child's device length learns the
+                            # fork point at its first consuming tick
+                            # (await siblings keep theirs pending).
+                            if self._slot_state[i] == "live":
+                                reset[i] = True
+                                reset_val[i] = self._live_reset.pop(i)
                         for i in live_idx:
                             self._ensure_blocks(
                                 i, len(self._slot_req[i].prompt)
                                 + len(self._slot_tokens[i])
                             )
-                        self._sync_table()
-                        self.tok, self.cache, self._key = self._mixed(
-                            self.params, self.tok[:, None],
-                            jnp.asarray(n_vec),
-                            jnp.zeros((self.slots,), bool),
-                            jnp.zeros((self.slots,), jnp.int32),
-                            jnp.asarray(emit), self.cache, self._key,
+                        sidx = np.asarray(
+                            [len(t) for t in self._slot_tokens], np.int32
                         )
+                        self._sync_table()
+                        self.tok, self._lp, fused_dev, _, \
+                            self.cache = self._mixed(
+                                self.params, self.tok[:, None],
+                                jnp.asarray(n_vec),
+                                jnp.asarray(reset),
+                                jnp.asarray(reset_val),
+                                jnp.asarray(emit), self.cache,
+                                self._keys, jnp.asarray(self._temp_np),
+                                jnp.asarray(self._topk_np),
+                                jnp.asarray(sidx), self._lp,
+                            )
                         stepped = True
 
                     awaits = [i for i, st in enumerate(self._slot_state)
@@ -2832,14 +3647,29 @@ class SlotServer:
                         # live inside this block. A verify tick fetches
                         # its fused (S, 1+Tq) output instead: the token
                         # vector AND every row argmax in the same sync.
+                        lp_valid = False
                         if all_tok_dev is not None:
                             # lint: allow[host-sync] THE one per-tick fetch (verify ticks: fused token vector + row argmaxes)
                             fused_host = np.asarray(all_tok_dev)
                             self._tok_host = fused_host[:, 0]
                             alltok_host = fused_host[:, 1:]
+                        elif fused_dev is not None:
+                            # lint: allow[host-sync] THE one per-tick fetch (token vector + bitcast logprobs, one fused array)
+                            fh = np.asarray(fused_dev)
+                            self._tok_host = fh[:, 0]
+                            self._lp_host = np.ascontiguousarray(
+                                fh[:, 1]
+                            ).view(np.float32)
+                            lp_valid = True
                         else:
+                            # Awaits-only tick (a synchronous whole
+                            # admission parked tokens, nothing stepped):
+                            # fetch the carried vectors directly.
                             # lint: allow[host-sync] THE one per-tick fetch (the batched token vector)
                             self._tok_host = np.asarray(self.tok)
+                            # lint: allow[host-sync] rides the same sync point (the parked first-token logprobs)
+                            self._lp_host = np.asarray(self._lp)
+                            lp_valid = True
                         now2 = time.monotonic()
                         if live_idx:
                             decode_ticks += 1
@@ -2848,7 +3678,12 @@ class SlotServer:
                             req = self._slot_req[i]
                             first = int(self._tok_host[i])
                             self._slot_tokens[i] = [first]
-                            self._push_token(req, first)
+                            if lp_valid:
+                                self._slot_cum_lp[i] = float(
+                                    self._lp_host[i]
+                                )
+                            self._push_token(req, first,
+                                             self._slot_index[i])
                             self._slot_state[i] = "live"
                             # Committed cache rows = the prompt; the
                             # first token is the pending tip (spec mode's
@@ -2875,6 +3710,20 @@ class SlotServer:
                                         "ttft_s": round(
                                             self._slot_ttft[i], 6),
                                     })
+                            # Family forks happen HERE — before the
+                            # parent's EOS/budget check, so even a
+                            # one-token parent yields n independent
+                            # samples (each sibling re-consumes the
+                            # last prompt token and draws its own
+                            # first token under its own key).
+                            fam = self._families.get(req.uid)
+                            if fam is not None and not fam.forked \
+                                    and i == fam.parent_slot:
+                                n_new = self._fork_family(
+                                    fam, i, tick, now2, results
+                                )
+                                tokens += n_new
+                                tokens_this_tick += n_new
                             if req.eos_id is not None \
                                     and first == req.eos_id:
                                 self._retire(i, tick, OUTCOME_EOS, results)
@@ -2896,8 +3745,17 @@ class SlotServer:
                             for i in live_idx:
                                 req = self._slot_req[i]
                                 tok_i = int(self._tok_host[i])
+                                # Every live slot enters this loop with
+                                # a first token already emitted (awaits
+                                # pass, or _fork_family for siblings) —
+                                # this is always an inter-token gap.
                                 self._slot_tokens[i].append(tok_i)
-                                self._push_token(req, tok_i)
+                                if lp_valid:
+                                    self._slot_cum_lp[i] += float(
+                                        self._lp_host[i]
+                                    )
+                                self._push_token(req, tok_i,
+                                                 self._slot_index[i])
                                 tokens += 1
                                 tokens_this_tick += 1
                                 gap = max(now2 - self._last_tok_t[i], 0.0)
@@ -2909,6 +3767,15 @@ class SlotServer:
                                 if obs.REGISTRY.enabled:
                                     _TOKENS.inc()
                                     _TBT.observe(gap)
+                                if (req.fork_at is not None
+                                        and self._slot_index[i] == 0
+                                        and len(self._slot_tokens[i])
+                                        == req.fork_at):
+                                    # Replayable mid-generation branch
+                                    # (trace knob): the request forks
+                                    # itself through the same mailbox
+                                    # an API caller would use.
+                                    self.fork(req.uid)
                                 if req.eos_id is not None \
                                         and tok_i == req.eos_id:
                                     self._retire(i, tick, OUTCOME_EOS,
@@ -2961,6 +3828,11 @@ class SlotServer:
                         "cancelled": self._tick_cancelled,
                         "deadline_expired": self._tick_deadline,
                         "shed": self._tick_shed,
+                        # Copy-on-write forks this tick (ISSUE 15) and
+                        # the ancestor blocks they shared instead of
+                        # copying.
+                        "forks": self._tick_forks,
+                        "shared_blocks": self._tick_fork_shared,
                         "draining": draining,
                     }
                     if self._paged:
@@ -3062,6 +3934,12 @@ class SlotServer:
                 "blocks_free": self._pool.free_count,
                 "peak_blocks_used": self._peak_blocks_used,
             }
+            if self._forks_life - fork0[0]:
+                # Copy-on-write fork accounting for THIS run (ISSUE 15).
+                kv_snap["forks"] = self._forks_life - fork0[0]
+                kv_snap["fork_blocks_shared"] = (
+                    self._fork_shared_life - fork0[1]
+                )
             if self._host_pool is not None:
                 h1 = self._host_pool.stats()
                 kv_snap.update({

@@ -312,11 +312,19 @@ class IngressServer(DaemonHTTPServer):
                                 f"no such endpoint: {method} {path}\n",
                                 "text/plain")
 
-    def _stats(self) -> Dict[str, Any]:
+    def _engine_alive(self) -> bool:
+        """The engine loop is up and has not crashed — the handler
+        watchdogs' liveness gate (a healthy engine legitimately goes
+        silent for long stretches, e.g. a best-of family holding its
+        streams until the join)."""
         with self._lock:
-            alive = (self._engine_thread is not None
-                     and self._engine_thread.is_alive()
-                     and self._engine_error is None)
+            return (self._engine_thread is not None
+                    and self._engine_thread.is_alive()
+                    and self._engine_error is None)
+
+    def _stats(self) -> Dict[str, Any]:
+        alive = self._engine_alive()
+        with self._lock:
             out = {
                 "queue_depth": self._queued,
                 "max_queue": self.max_queue,
@@ -383,6 +391,13 @@ class IngressServer(DaemonHTTPServer):
 
         events: "queue.Queue" = queue.Queue()
         deadline = body.get("deadline_s", self.default_deadline_s)
+        # How many per-branch finish events end the stream: n parallel
+        # completions, or ONE for best_of (the engine streams only the
+        # selected winner, as branch 0). Extra branches a mid-generation
+        # fork(uid)/fork_at adds stream tagged by their index but never
+        # gate the close.
+        best_of = body.get("best_of")
+        n_expected = 1 if (best_of or 0) > 1 else body["n"]
         request = Request(
             uid=uid,
             prompt=np.asarray(body["prompt"], np.int32),
@@ -390,8 +405,15 @@ class IngressServer(DaemonHTTPServer):
             eos_id=body.get("eos_id"),
             deadline_s=(time.monotonic() + deadline
                         if deadline is not None else None),
-            on_token=lambda t: events.put(("token", t)),
-            on_finish=lambda res: events.put(("finish", res)),
+            n=body["n"],
+            best_of=best_of,
+            temperature=body.get("temperature"),
+            top_k=body.get("top_k"),
+            seed=body.get("seed"),
+            fork_at=body.get("fork_at"),
+            on_branch_token=lambda i, t: events.put(("token", (i, t))),
+            on_branch_finish=lambda i, res: events.put(
+                ("finish", (i, res))),
         )
         # Idempotent TTFT-phase exit: whichever comes first — first
         # token, finish, or a disconnect — releases exactly one unit of
@@ -413,9 +435,11 @@ class IngressServer(DaemonHTTPServer):
             return
         try:
             if body.get("stream", True):
-                self._stream_sse(req, uid, events, dequeue_once)
+                self._stream_sse(req, uid, events, dequeue_once,
+                                 n_expected)
             else:
-                self._respond_whole(req, uid, events, dequeue_once)
+                self._respond_whole(req, uid, events, dequeue_once,
+                                    n_expected)
         except BaseException as e:
             # ANY handler failure — a vanished client (the disconnect
             # arc the chaos harness storms: BrokenPipe/ConnectionReset/
@@ -429,7 +453,7 @@ class IngressServer(DaemonHTTPServer):
                 log.exception("completions handler failed (rid %d)", uid)
             self.engine.cancel(uid)
             dequeue_once()
-            self._drain_events(events)
+            self._drain_events(events, n_expected)
             raise  # DaemonHTTPServer swallows the socket kinds
 
     def _parse_body(self, req: BaseHTTPRequestHandler):
@@ -461,8 +485,37 @@ class IngressServer(DaemonHTTPServer):
                 body["deadline_s"] = float(body["deadline_s"])
             if body.get("eos_id") is not None:
                 body["eos_id"] = int(body["eos_id"])
+            # Sampling + fork-family fields (ISSUE 15, OpenAI-shaped):
+            # n parallel completions, best_of server-side selection,
+            # temperature/top_k/seed sampling overrides, fork_at for
+            # replayable mid-generation branches.
+            body["n"] = int(body.get("n", 1))
+            if body.get("best_of") is not None:
+                body["best_of"] = int(body["best_of"])
+            if body.get("temperature") is not None:
+                body["temperature"] = float(body["temperature"])
+            if body.get("top_k") is not None:
+                body["top_k"] = int(body["top_k"])
+            if body.get("seed") is not None:
+                body["seed"] = int(body["seed"])
+            if body.get("fork_at") is not None:
+                body["fork_at"] = int(body["fork_at"])
         except (TypeError, ValueError) as e:
-            return None, f"non-numeric max_tokens/deadline_s/eos_id: {e}"
+            return None, (f"non-numeric max_tokens/deadline_s/eos_id/"
+                          f"n/best_of/temperature/top_k/seed/fork_at: {e}")
+        if body["n"] < 1:
+            return None, "body.n must be >= 1"
+        if body.get("best_of") is not None and body["best_of"] < 1:
+            return None, "body.best_of must be >= 1"
+        if (body.get("best_of") or 0) > 1 and body["n"] != 1:
+            return None, ("body.best_of runs server-side selection and "
+                          "streams ONE winner — it requires n == 1")
+        if body.get("temperature") is not None and body["temperature"] < 0:
+            return None, "body.temperature must be >= 0"
+        if body.get("top_k") is not None and body["top_k"] < 0:
+            return None, "body.top_k must be >= 0 (0 = off)"
+        if body.get("fork_at") is not None and body["fork_at"] < 1:
+            return None, "body.fork_at must be >= 1"
         return body, None
 
     def _retry_after(self, depth: int) -> int:
@@ -484,29 +537,40 @@ class IngressServer(DaemonHTTPServer):
             _QUEUE_DEPTH.set(depth)
 
     @staticmethod
-    def _drain_events(events: "queue.Queue") -> None:
+    def _drain_events(events: "queue.Queue", n_expected: int = 1) -> None:
         """After a disconnect: keep draining callback events until the
-        engine retires the request, so the queue (and the Request the
-        engine still holds) can be collected."""
+        engine retires every branch of the request, so the queue (and
+        the Request the engine still holds) can be collected."""
+        seen = 0
         while True:
             try:
-                kind, _ = events.get(timeout=30.0)
+                kind, payload = events.get(timeout=30.0)
             except queue.Empty:
                 return  # engine gone/wedged; nothing more to free
             if kind == "finish":
-                return
+                idx, _ = payload
+                if idx < n_expected:
+                    seen += 1
+                    if seen >= n_expected:
+                        return
 
     # -- response writers --------------------------------------------------
 
     def _stream_sse(self, req: BaseHTTPRequestHandler, uid: int,
-                    events: "queue.Queue", dequeue_once) -> None:
-        """SSE token stream: one ``data:`` event per committed token, a
-        final event carrying ``finish_reason`` + usage, then ``[DONE]``.
-        Keepalive comments between tokens probe for vanished clients;
-        ~30 s of total engine silence (no event at all — tokens reset
-        the clock) means the engine thread is gone: cancel, emit an
-        error finish, return — a connected client must not hold an
-        admission-queue unit against a dead engine forever."""
+                    events: "queue.Queue", dequeue_once,
+                    n_expected: int = 1) -> None:
+        """SSE token stream: one ``data:`` event per committed token
+        (``choices[].index`` tags the branch — n>1 completions
+        interleave on ONE stream, the OpenAI shape), one finish event
+        per branch, then ``[DONE]`` once all ``n_expected`` branches
+        finished. Keepalive comments between tokens probe for vanished
+        clients; ~30 s of total silence from a DEAD engine thread
+        (crashed or exited) cancels with an error finish — a connected
+        client must not hold an admission-queue unit against a dead
+        engine forever. A LIVE engine may legitimately go silent far
+        longer (a best-of family streams nothing until its join), so
+        silence alone never cancels; the server-side bound there is
+        the request's own deadline_s."""
         if obs.REGISTRY.enabled:
             _HTTP_REQUESTS.labels(route="completions", code="200").inc()
         req.send_response(200)
@@ -514,12 +578,14 @@ class IngressServer(DaemonHTTPServer):
         req.send_header("Cache-Control", "no-cache")
         req.end_headers()
         silent = 0
+        finished = 0
         while True:
             try:
                 kind, payload = events.get(timeout=self.keepalive_s)
             except queue.Empty:
                 silent += 1
-                if silent * self.keepalive_s >= 30.0:
+                if silent * self.keepalive_s >= 30.0 \
+                        and not self._engine_alive():
                     self.engine.cancel(uid)
                     dequeue_once()
                     req.wfile.write(b"data: " + json.dumps(
@@ -538,29 +604,42 @@ class IngressServer(DaemonHTTPServer):
                 continue
             silent = 0
             if kind == "token":
+                idx, tok = payload
                 dequeue_once()
-                req.wfile.write(_sse_token(uid, payload))
+                req.wfile.write(_sse_token(uid, tok, idx))
                 req.wfile.flush()
             else:
-                result: RequestResult = payload
+                idx, result = payload
+                result: RequestResult
                 dequeue_once()
-                req.wfile.write(_sse_finish(uid, result))
-                req.wfile.write(b"data: [DONE]\n\n")
+                req.wfile.write(_sse_finish(uid, result, idx))
+                if idx < n_expected:
+                    finished += 1
+                if finished >= n_expected:
+                    req.wfile.write(b"data: [DONE]\n\n")
+                    req.wfile.flush()
+                    return
                 req.wfile.flush()
-                return
 
     def _respond_whole(self, req: BaseHTTPRequestHandler, uid: int,
-                       events: "queue.Queue", dequeue_once) -> None:
-        """``stream: false``: block until the request finishes, answer
-        with one JSON body. The wait is bounded per EVENT (tokens reset
-        it): 30 s of total silence means the engine thread is gone —
-        cancel and answer rather than hang the handler (and its
-        admission-queue unit) forever; the SSE path gets the same bound
-        from its keepalive probe + _drain_events."""
-        while True:
+                       events: "queue.Queue", dequeue_once,
+                       n_expected: int = 1) -> None:
+        """``stream: false``: block until every branch finishes, answer
+        with one JSON body (``choices`` sorted by index — the OpenAI
+        n>1 shape). The wait is bounded per EVENT (tokens reset it):
+        30 s of silence from a DEAD engine thread cancels with a 503
+        rather than hang the handler (and its admission-queue unit)
+        forever — a LIVE engine may legitimately be silent that long
+        (a best-of family emits nothing until its join), so silence
+        alone keeps waiting; deadline_s is the server-side bound
+        there."""
+        finished: List[RequestResult] = []
+        while len(finished) < n_expected:
             try:
                 kind, payload = events.get(timeout=30.0)
             except queue.Empty:
+                if self._engine_alive():
+                    continue  # quiet but healthy — keep waiting
                 self.engine.cancel(uid)
                 dequeue_once()
                 self._reply_counted(
@@ -576,24 +655,29 @@ class IngressServer(DaemonHTTPServer):
                 # admission queue.
                 dequeue_once()
                 continue
-            result: RequestResult = payload
-            break
+            idx, result = payload
+            if idx < n_expected:
+                finished.append(result)
         dequeue_once()
-        reason = FINISH_REASONS.get(result.outcome, result.outcome)
-        code = 200 if result.tokens or reason in ("stop", "length") else 503
+        finished.sort(key=lambda r: r.index)
+        best = finished[0]
+        code = 200 if any(
+            r.tokens or FINISH_REASONS.get(r.outcome, r.outcome)
+            in ("stop", "length") for r in finished
+        ) else 503
         self._reply_counted(req, "completions", code, json.dumps({
             "id": f"cmpl-{uid}",
             "object": "text_completion",
             "choices": [{
-                "index": 0,
-                "text": _render(result.tokens),
-                "token_ids": list(result.tokens),
-                "finish_reason": reason,
-            }],
+                "index": r.index,
+                "text": _render(r.tokens),
+                "token_ids": list(r.tokens),
+                "finish_reason": FINISH_REASONS.get(r.outcome, r.outcome),
+            } for r in finished],
             "usage": {
-                "prompt_tokens": result.prompt_len,
-                "completion_tokens": len(result.tokens),
-                "prefix_hit_tokens": result.prefix_hit_tokens,
+                "prompt_tokens": best.prompt_len,
+                "completion_tokens": sum(len(r.tokens) for r in finished),
+                "prefix_hit_tokens": best.prefix_hit_tokens,
             },
         }, indent=2), "application/json")
 
@@ -607,12 +691,12 @@ def _render(tokens) -> str:
     return " ".join(str(int(t)) for t in tokens)
 
 
-def _sse_token(uid: int, tok: int) -> bytes:
+def _sse_token(uid: int, tok: int, index: int = 0) -> bytes:
     return ("data: " + json.dumps({
         "id": f"cmpl-{uid}",
         "object": "text_completion",
         "choices": [{
-            "index": 0,
+            "index": index,
             "text": f"{int(tok)} ",
             "token_ids": [int(tok)],
             "finish_reason": None,
@@ -620,12 +704,13 @@ def _sse_token(uid: int, tok: int) -> bytes:
     }) + "\n\n").encode()
 
 
-def _sse_finish(uid: int, result: RequestResult) -> bytes:
+def _sse_finish(uid: int, result: RequestResult,
+                index: int = 0) -> bytes:
     return ("data: " + json.dumps({
         "id": f"cmpl-{uid}",
         "object": "text_completion",
         "choices": [{
-            "index": 0,
+            "index": index,
             "text": "",
             "token_ids": [],
             "finish_reason": FINISH_REASONS.get(result.outcome,

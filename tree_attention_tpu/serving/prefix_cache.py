@@ -184,6 +184,22 @@ class _RadixBase:
             n.refs -= 1
             assert n.refs >= 0, "prefix node ref underflow"
 
+    def repin(self, nodes: List[_Node]) -> List[_Node]:
+        """Take one MORE pin on each node of an already-pinned path — the
+        copy-on-write fork's radix arc (ISSUE 15): a forked sibling
+        shares its parent's matched/published ancestor blocks, so it
+        holds its own pins on the same nodes and releases them through
+        its own retire, exactly like a second admission that matched the
+        same path (without re-walking: the parent's pins prove the path
+        is alive). Returns the nodes as the child's pinned set; the
+        caller must ledger it — the ``ledger-leak`` lint pass tracks
+        this acquire site."""
+        for n in nodes:
+            assert n.refs > 0, "repin of an unpinned prefix node"
+            n.refs += 1
+            self._touch(n)
+        return list(nodes)
+
     def total_pins(self) -> int:
         """Sum of every node's refcount — the pin-balance truth. A
         drained engine (every request retired, however it exited) must

@@ -76,6 +76,10 @@ class RunConfig:
     # Generate mode.
     temperature: float = 0.8
     max_new_tokens: int = 32
+    # Serve-mode sampling (ISSUE 15): per-slot top-k cutoff (0 = off);
+    # --temperature is shared with generate mode. Per-request bodies on
+    # the HTTP ingress override both.
+    top_k: int = 0
 
     # Serve mode (continuous batching over a synthetic request trace).
     slots: int = 8           # concurrent cache slots (max in-flight requests)
@@ -249,7 +253,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-layers", type=int, default=d.n_layers)
     p.add_argument("--vocab-size", type=int, default=d.vocab_size)
     p.add_argument("--temperature", type=float, default=d.temperature,
-                   help="generate mode: sampling temperature (0 = greedy)")
+                   help="generate/serve mode: sampling temperature "
+                        "(0 = greedy; serve mode threads per-slot PRNG "
+                        "keys so fixed-seed runs resample bit-for-bit)")
+    p.add_argument("--top-k", type=int, default=d.top_k,
+                   help="serve mode: restrict sampling to the k highest "
+                        "logits per step (0 = off; only applies when "
+                        "--temperature > 0). Per-request bodies on "
+                        "--serve-http override both knobs")
     p.add_argument("--max-new-tokens", type=int, default=d.max_new_tokens,
                    help="generate/serve mode: number of tokens to sample "
                         "per request")
