@@ -744,6 +744,22 @@ def _serving_forked_record():
     return bench_serving_forked_sampling()
 
 
+def _serving_telemetry_record():
+    """Request-telemetry overhead (ISSUE 16): the fleet trace replayed
+    through the router with end-to-end request telemetry ON (traceparent
+    propagation, flow events, per-request cost ledgers) vs ALL OFF on
+    the same engines — tokens/sec and TTFT p50 gated within 5%, the
+    disabled path asserted allocation-free (ledger untouched by a full
+    replay), and the on arm's trace sink checked for the complete
+    router->replica flow chain. CPU proxy; the overhead structure is
+    the claim. See tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import (
+        bench_serving_request_telemetry,
+    )
+
+    return bench_serving_request_telemetry()
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -983,6 +999,7 @@ def _run_suite() -> None:
     run("serving_disagg", _serving_disagg_record)
     run("serving_tiered_kv", _serving_tiered_record)
     run("serving_forked_sampling", _serving_forked_record)
+    run("serving_request_telemetry", _serving_telemetry_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1151,6 +1168,16 @@ def _summarize_record(name, rec):
         ratio = rec.get("trace", {}).get("ttft_p50_ratio")
         if ratio is not None:
             out["fork_ttft_p50_ratio"] = ratio
+    if name == "serving_request_telemetry":
+        ov = rec.get("overhead", {})
+        for key in ("tokens_per_sec_ratio", "ttft_p50_ratio"):
+            if key in ov:
+                out[key] = ov[key]
+        flows = rec.get("on", {}).get("flow_events")
+        if flows:
+            out["flow_events"] = sum(flows.values())
+        if "ledgers_recorded" in rec.get("on", {}):
+            out["ledgers_recorded"] = rec["on"]["ledgers_recorded"]
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

@@ -130,6 +130,17 @@ _SPEC.loader.exec_module(bc)
     ("completions_family", None),
     ("naive_pool_bytes_ratio", None),
     ("fork_at", None),
+    # Request-telemetry family (ISSUE 16): the on/off tokens-per-sec
+    # ratio is larger-is-better (overhead shrinks it), the on/off TTFT
+    # ratio smaller-is-better (overhead grows it); ledger bookkeeping
+    # counts and the configured gate budget are workload shape that
+    # skips.
+    ("tokens_per_sec_ratio", bc.LARGER_IS_BETTER),
+    ("ttft_p50_ratio", bc.SMALLER_IS_BETTER),
+    ("ledgers_recorded", None),
+    ("tokens_decoded_ledgered", None),
+    ("prefix_hit_ledgered", None),
+    ("overhead_budget", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family
@@ -210,6 +221,23 @@ def test_compare_flags_tiered_hit_rate_collapse():
     assert len(regs) == 2
     assert any("hit_rate_improvement" in r for r in regs)
     assert any("restore_ratio" in r for r in regs)
+
+
+def test_compare_flags_telemetry_overhead_regression():
+    # Telemetry overhead creeping past the record's gate margin IS the
+    # regression (the tok/s ratio drops, the TTFT ratio grows); ledger
+    # counts moving with the trace is not.
+    base = {"serving_request_telemetry": {"overhead": {
+        "tokens_per_sec_ratio": 0.99, "ttft_p50_ratio": 1.01,
+    }, "on": {"ledgers_recorded": 24}}}
+    cand = {"serving_request_telemetry": {"overhead": {
+        "tokens_per_sec_ratio": 0.55, "ttft_p50_ratio": 1.9,
+    }, "on": {"ledgers_recorded": 48}}}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 2
+    assert any("tokens_per_sec_ratio" in r for r in regs)
+    assert any("ttft_p50_ratio" in r for r in regs)
 
 
 def _rec(**trace):

@@ -209,6 +209,39 @@ class TestObsGuard:
         ), path=OBS_FLIGHT)
         assert fs == []
 
+    def test_unguarded_reqlog_seam_flagged(self):
+        # ISSUE 16: ledger accumulation rides the engine's hot seams —
+        # same machine-checked discipline as counters and spans.
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def retire(uid, n):\n"
+            "    obs.REQLOG.finish(uid, outcome='completed', tick=n)\n"
+        ))
+        assert len(fs) == 1 and "REQLOG.finish" in fs[0].message
+
+    def test_guarded_reqlog_seam_clean(self):
+        fs = run("obs-guard", (
+            "from tree_attention_tpu import obs\n"
+            "def retire(uid, n):\n"
+            "    if obs.REQLOG.enabled:\n"
+            "        obs.REQLOG.finish(uid, outcome='completed', tick=n)\n"
+        ))
+        assert fs == []
+
+    def test_reqlog_module_in_scope_unlike_obs_peers(self):
+        # obs/reqlog.py is the ONE obs/ module inside the guard scope:
+        # its finish() emits a tracer instant, so it carries the same
+        # burden as engine code. Its siblings stay exempt.
+        snippet = (
+            "from tree_attention_tpu import obs\n"
+            "def f(x):\n"
+            "    obs.instant('evt', cat='serving', args={'x': x})\n"
+        )
+        assert run("obs-guard", snippet, path=OBS_FLIGHT) == []
+        fs = run("obs-guard", snippet,
+                 path="tree_attention_tpu/obs/reqlog.py")
+        assert len(fs) == 1
+
 
 # ---------------------------------------------------------------------------
 # host-sync
@@ -622,6 +655,32 @@ class TestLockSafety:
             "            pass\n"
         ), path="tree_attention_tpu/obs/slo.py")
         assert fs == []
+
+    def test_reqlog_ring_mutation_needs_lock(self):
+        # ISSUE 16: the request ledger is written by ingress handler
+        # threads (open/finish) and read by the obs HTTP thread
+        # (snapshot) — obs/ scope applies unchanged: every container
+        # mutation under the RLock, the lock-free `enabled` flag stays
+        # the sanctioned fast path.
+        base = (
+            "import threading\n"
+            "class ReqLog:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._live = {{}}\n"
+            "        self.enabled = False\n"
+            "    def open(self, uid, led):\n"
+            "{body}"
+        )
+        path = "tree_attention_tpu/obs/reqlog.py"
+        bad = run("lock-safety",
+                  base.format(body="        self._live[uid] = led\n"),
+                  path=path)
+        good = run("lock-safety", base.format(body=(
+            "        with self._lock:\n"
+            "            self._live[uid] = led\n")), path=path)
+        assert len(bad) == 1 and "self._live" in bad[0].message
+        assert good == []
 
     def test_signal_path_emission_flagged(self):
         fs = run("lock-safety", (
@@ -1085,6 +1144,90 @@ class TestDonationSafety:
             "    def g(self):\n"
             "        out = self._step(self.cache)\n"
             "        return self.cache\n"
+        ), path="tree_attention_tpu/serving/router.py")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# handoff-transfer (ISSUE 16)
+
+
+class TestHandoffTransfer:
+    @staticmethod
+    def _adopt_src(skip=()):
+        from tools.lintlib.handoff import ADOPTED_SLOT_FIELDS
+        lines = [
+            "class DisaggServer:\n",
+            "    def _adopt(self, req, d):\n",
+            "        pf, dc = self.prefill, self.decode\n",
+        ]
+        for name in sorted(ADOPTED_SLOT_FIELDS - set(skip)):
+            lines.append(f"        dc.{name}[d] = pf.{name}[0]\n")
+        return "".join(lines)
+
+    def test_untabled_engine_slot_field_flagged(self):
+        fs = run("handoff-transfer", (
+            "class SlotServer:\n"
+            "    def __init__(self):\n"
+            "        self._slot_req = [None]\n"
+            "        self._slot_frobnicate = [0]\n"
+        ))
+        assert len(fs) == 1 and "_slot_frobnicate" in fs[0].message
+
+    def test_tabled_and_exempt_fields_clean(self):
+        # Plain stores, subscripted rows, and AugAssign rebinds of
+        # tabled (or exempt) fields all resolve to the same attribute.
+        fs = run("handoff-transfer", (
+            "class SlotServer:\n"
+            "    def __init__(self):\n"
+            "        self._slot_req = [None]\n"
+            "        self._slot_logits = None\n"
+            "    def tick(self, s):\n"
+            "        self._slot_clen[s] += 1\n"
+        ))
+        assert fs == []
+
+    def test_complete_adopt_clean(self):
+        assert run("handoff-transfer", self._adopt_src(),
+                   path=DISAGG) == []
+
+    def test_dropped_transfer_flagged(self):
+        fs = run("handoff-transfer",
+                 self._adopt_src(skip=("_slot_span",)), path=DISAGG)
+        assert len(fs) == 1 and "_slot_span" in fs[0].message
+        assert "ADOPT_EXEMPT" in fs[0].message
+
+    def test_missing_decode_binding_flagged(self):
+        fs = run("handoff-transfer", (
+            "class DisaggServer:\n"
+            "    def _adopt(self, req, d):\n"
+            "        self.decode._slot_req[d] = req\n"
+        ), path=DISAGG)
+        assert len(fs) == 1 and "decode receiver" in fs[0].message
+
+    def test_tables_match_real_tree(self):
+        # Reverse drift (a tabled name engine.py no longer builds) is
+        # pinned HERE against the real tree — the donation pass's
+        # convention — so the fixture snippets above stay usable.
+        from tools.lintlib import handoff
+        path = os.path.join(lintlib.REPO_ROOT, ENGINE)
+        with open(path) as fh:
+            src = lintlib.Source(ENGINE, fh.read())
+        discovered = handoff._engine_slot_fields(src.tree)
+        tabled = handoff.ADOPTED_SLOT_FIELDS | set(handoff.ADOPT_EXEMPT)
+        assert tabled == discovered
+        # And the real _adopt covers the full table (re-checked here so
+        # the suite fails even if a lint baseline grandfathers it).
+        dis = os.path.join(lintlib.REPO_ROOT, DISAGG)
+        with open(dis) as fh:
+            assert lintlib.run_source("handoff-transfer", fh.read(),
+                                      DISAGG) == []
+
+    def test_out_of_scope_files_skipped(self):
+        fs = run("handoff-transfer", (
+            "class X:\n"
+            "    def __init__(self):\n"
+            "        self._slot_mystery = 0\n"
         ), path="tree_attention_tpu/serving/router.py")
         assert fs == []
 

@@ -1,0 +1,382 @@
+"""End-to-end request telemetry (ISSUE 16).
+
+The contracts, pinned here:
+
+- **Context propagation** — W3C traceparent make/parse round-trips and
+  rejects malformed input; a client-supplied trace id survives the
+  router relay into the replica's per-request ledger; the Chrome-trace
+  flow chain (``s`` at the router, ``t`` at ingress adoption and engine
+  admission, ``f`` at retire) shares one trace-derived flow id, so a
+  merged Perfetto load draws ONE connected arrow per request.
+- **Cost attribution** — the ledger's wall segments reconcile
+  (``prefill_s + handoff_s + decode_s == wall_s`` by construction, the
+  disagg handoff charged to its own segment), SSE/whole-response
+  ``usage`` carries the finished ledger, ``ServeReport`` exports
+  run-level aggregates, and :func:`aggregate_ledgers` is pure.
+- **Introspection** — the obs HTTP server's ``/requests``,
+  ``/request/{uid}`` and ``/slots`` endpoints; the router's federated
+  ``/requests`` / ``/healthz`` / ``/flight`` roll-ups over its
+  replicas (in-process replicas report under the ``local`` label).
+- **Merging** — ``tools/trace_merge.py`` re-keys colliding pids and
+  preserves flow ids.
+
+Budget discipline (the tier-1 ceiling): ONE module-scoped loopback
+fleet (2 replicas x 2 slots, tiny config) serves every HTTP test; ONE
+disaggregated pair pins the handoff ledger; everything else is pure.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from tools.trace_merge import merge_traces
+from tree_attention_tpu import obs
+from tree_attention_tpu.bench.serving import (
+    _wait_engine_settled,
+    serving_model_config,
+)
+from tree_attention_tpu.models import init_params
+from tree_attention_tpu.serving import DisaggServer, Request, SlotServer
+from tree_attention_tpu.serving.fleet import FleetSupervisor, LocalReplica
+from tree_attention_tpu.serving.router import FleetRouter
+
+BLOCK = 8
+CFG = serving_model_config(d_model=64, vocab_size=128, max_seq_len=64)
+CACHE_LEN = 64
+SLOTS = 2
+PROMPT = [7, 9, 4, 7, 9, 4, 7, 9]  # one prefill bucket for every test
+
+
+# ---------------------------------------------------------------------------
+# pure: traceparent, flow ids, aggregation, trace merging
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_make_parse_roundtrip(self):
+        tid, sid = obs.new_trace_id(), obs.new_span_id()
+        header = obs.make_traceparent(tid, sid)
+        assert header == f"00-{tid}-{sid}-01"
+        assert obs.parse_traceparent(header) == (tid, sid)
+
+    def test_ids_are_fresh_hex(self):
+        tids = {obs.new_trace_id() for _ in range(8)}
+        assert len(tids) == 8
+        for t in tids:
+            assert len(t) == 32 and int(t, 16)
+        assert len(obs.new_span_id()) == 16
+
+    @pytest.mark.parametrize("bad", [
+        "",
+        "garbage",
+        "00-abc-def-01",                                  # wrong lengths
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",        # non-hex
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",        # all-zero trace
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",        # all-zero span
+    ])
+    def test_malformed_rejected(self, bad):
+        assert obs.parse_traceparent(bad) is None
+
+    def test_flow_id_is_json_double_safe(self):
+        tid = obs.new_trace_id()
+        fid = obs.flow_id(tid)
+        assert 0 <= fid < (1 << 53)
+        assert obs.flow_id(tid) == fid  # deterministic per trace
+
+    def test_aggregate_ledgers_pure(self):
+        assert obs.aggregate_ledgers([]) is None
+        agg = obs.aggregate_ledgers([
+            {"prefill_s": 0.1, "decode_s": 0.4, "tokens_decoded": 4},
+            {"prefill_s": 0.3, "decode_s": 0.2, "tokens_decoded": 6},
+        ])
+        assert agg["count"] == 2
+        assert agg["prefill_s_sum"] == pytest.approx(0.4)
+        assert agg["prefill_s_p50"] == pytest.approx(0.3)
+        assert agg["tokens_decoded_total"] == 10
+
+
+class TestTraceMerge:
+    def _log(self, name, fid, extra=()):
+        lines = [
+            {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "host rank 0"}},
+            {"name": "request", "cat": "serving", "ph": "s", "id": fid,
+             "ts": 10, "pid": 0, "tid": 1},
+        ]
+        lines.extend(extra)
+        return name, [json.dumps(e) for e in lines]
+
+    def test_pids_rekeyed_flow_ids_preserved(self):
+        fid = obs.flow_id(obs.new_trace_id())
+        end = {"name": "request", "cat": "serving", "ph": "f",
+               "bp": "e", "id": fid, "ts": 25, "pid": 0, "tid": 1}
+        merged, skipped = merge_traces([
+            self._log("router.jsonl", fid),
+            self._log("replica.jsonl", fid, extra=[end]),
+        ])
+        evs = merged["traceEvents"]
+        assert skipped == 0
+        # Both inputs wrote pid 0; the merge gives each its own row.
+        assert {e["pid"] for e in evs} == {0, 1}
+        names = [e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert names == ["host rank 0 [router.jsonl]",
+                         "host rank 0 [replica.jsonl]"]
+        # The flow id is the cross-process stitch: untouched, and now
+        # spanning two distinct pids.
+        flows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+        assert {e["id"] for e in flows} == {fid}
+        assert {e["pid"] for e in flows} == {0, 1}
+
+    def test_malformed_lines_skipped_not_fatal(self):
+        name, lines = self._log("crashed.jsonl", 42)
+        lines.append('{"truncated": ')  # mid-write crash artifact
+        merged, skipped = merge_traces([(name, lines)])
+        assert skipped == 1
+        assert len(merged["traceEvents"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# live fleet: propagation, usage export, federation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+
+    def make_engine():
+        return SlotServer(
+            params, CFG, slots=SLOTS, cache_len=CACHE_LEN,
+            prefill_chunk=BLOCK, prefix_cache=True, prefix_block=BLOCK,
+            kv_blocks=SLOTS * (CACHE_LEN // BLOCK) + 16,
+        )
+
+    reps = [LocalReplica(f"r{i}", make_engine, max_queue=16,
+                         default_max_tokens=4, keepalive_s=0.1)
+            for i in range(2)]
+    router = FleetRouter(block=BLOCK, affinity=True, hysteresis=2)
+    sup = FleetSupervisor(reps, router=router, monitor_interval_s=0)
+    obs.REQLOG.arm()
+    port = sup.start()
+    try:
+        yield {"port": port, "router": router, "sup": sup,
+               "engines": sup.engines}
+    finally:
+        sup.stop()
+        obs.REQLOG.disarm()
+
+
+def _post(port, body, headers=None, path="/v1/completions"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json", **(headers or {})})
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read()))
+    conn.close()
+    return out
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    try:
+        return resp.status, json.loads(raw)
+    except ValueError:
+        return resp.status, raw.decode()
+
+
+def _settle(fleet):
+    for eng in fleet["engines"]:
+        _wait_engine_settled(eng)
+
+
+class TestEndToEnd:
+    def test_client_trace_id_survives_router_into_ledger(self, fleet):
+        tid, sid = obs.new_trace_id(), obs.new_span_id()
+        status, body = _post(
+            fleet["port"],
+            {"prompt": PROMPT, "max_tokens": 3, "stream": False},
+            headers={obs.TRACEPARENT_HEADER:
+                     obs.make_traceparent(tid, sid)},
+        )
+        _settle(fleet)
+        assert status == 200
+        ledger = body["usage"]["ledger"]
+        # The replica ADOPTED the relayed context: same trace id end to
+        # end; the parent span is the router's relay hop, not ours.
+        assert ledger["trace_id"] == tid
+        assert ledger["parent_span_id"] not in ("", sid)
+        assert ledger["outcome"] == "budget"
+        assert ledger["tokens_decoded"] == 3
+        # Reconciliation: the in-span segments sum to the span wall
+        # (decode is the closed remainder; queueing is pre-span). The
+        # contract is exact in memory, but as_dict rounds each field to
+        # 6 decimals, so the 3-term sum can miss the rounded wall by 2e-6.
+        assert ledger["prefill_s"] + ledger["handoff_s"] \
+            + ledger["decode_s"] == pytest.approx(ledger["wall_s"], abs=5e-6)
+        assert ledger["handoff_s"] == 0.0  # fused engine: no park
+
+    def test_usage_ledger_minted_when_client_sends_none(self, fleet):
+        status, body = _post(
+            fleet["port"],
+            {"prompt": PROMPT, "max_tokens": 2, "stream": False},
+        )
+        _settle(fleet)
+        assert status == 200
+        ledger = body["usage"]["ledger"]
+        assert len(ledger["trace_id"]) == 32 and int(ledger["trace_id"], 16)
+
+    def test_router_federates_requests_with_replica_labels(self, fleet):
+        status, body = _post(
+            fleet["port"],
+            {"prompt": PROMPT, "max_tokens": 2, "stream": False},
+        )
+        _settle(fleet)
+        uid = int(body["id"].split("-", 1)[1])
+        status, fed = _get(fleet["port"], "/requests")
+        assert status == 200
+        recent = {e["uid"]: e for e in fed["recent"]}
+        assert uid in recent
+        # In-process replicas share the router's ledger: local label.
+        assert recent[uid]["replica"] == "local"
+        assert fed["live"] == []
+
+    def test_router_federated_health_and_flight(self, fleet):
+        status, health = _get(fleet["port"], "/healthz")
+        assert status == 200
+        assert health["status"] == "ok"
+        assert "router" in health and "replicas" in health
+        status, flight = _get(fleet["port"], "/flight")
+        assert status == 200
+        assert "router" in flight and "replicas" in flight
+
+    def test_flow_chain_in_trace_file(self, fleet, tmp_path):
+        sink = str(tmp_path / "trace.jsonl")
+        obs.TRACER.start(sink)
+        try:
+            tid = obs.new_trace_id()
+            status, _ = _post(
+                fleet["port"],
+                {"prompt": PROMPT, "max_tokens": 2, "stream": False},
+                headers={obs.TRACEPARENT_HEADER:
+                         obs.make_traceparent(tid, obs.new_span_id())},
+            )
+            _settle(fleet)
+            assert status == 200
+        finally:
+            obs.TRACER.close()
+        fid = obs.flow_id(tid)
+        flows = [e for e in map(json.loads, open(sink))
+                 if e.get("ph") in ("s", "t", "f") and e.get("id") == fid]
+        phases = [e["ph"] for e in flows]
+        # One connected chain: router starts it, ingress adoption and
+        # engine admission bind it through, retire ends it.
+        assert phases.count("s") == 1
+        assert phases.count("t") >= 2
+        assert phases[-1] == "f"
+        assert all(e["name"] == "request" for e in flows)
+
+    def test_obs_server_requests_slots_and_detail(self, fleet):
+        from tree_attention_tpu.obs.http import MetricsHTTPServer
+
+        status, body = _post(
+            fleet["port"],
+            {"prompt": PROMPT, "max_tokens": 2, "stream": False},
+        )
+        _settle(fleet)
+        uid = int(body["id"].split("-", 1)[1])
+        srv = MetricsHTTPServer(engine=fleet["engines"][0])
+        port = srv.start()
+        try:
+            status, snap = _get(port, "/requests")
+            assert status == 200 and snap["enabled"]
+            assert any(e["uid"] == uid for e in snap["recent"])
+            status, detail = _get(port, f"/request/{uid}")
+            assert status == 200 and detail["uid"] == uid
+            assert detail["outcome"] == "budget"
+            assert [p["phase"] for p in detail["phases"]] == [
+                "queue", "prefill", "handoff", "decode"]
+            assert _get(port, "/request/999999")[0] == 404
+            assert _get(port, "/request/nope")[0] == 400
+            status, slots = _get(port, "/slots")
+            assert status == 200 and len(slots) == SLOTS
+        finally:
+            srv.stop()
+
+    def test_slots_404_without_engine(self):
+        from tree_attention_tpu.obs.http import MetricsHTTPServer
+
+        srv = MetricsHTTPServer()
+        port = srv.start()
+        try:
+            assert _get(port, "/slots")[0] == 404
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated pair: the handoff segment + ServeReport aggregates
+# ---------------------------------------------------------------------------
+
+
+class TestDisaggLedger:
+    def test_handoff_charged_and_reconciled(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        server = DisaggServer(
+            params, CFG, prefill_slots=1, decode_slots=2,
+            cache_len=CACHE_LEN, prefill_chunk=BLOCK,
+        )
+        obs.REQLOG.arm()
+        try:
+            report = server.serve([
+                Request(uid=10_000 + i,
+                        prompt=np.asarray(PROMPT, np.int32),
+                        max_new_tokens=4, arrival_tick=2 * i)
+                for i in range(3)
+            ])
+        finally:
+            ledgers = [r.ledger for r in report.results]
+            obs.REQLOG.disarm()
+        assert all(lg is not None for lg in ledgers)
+        for lg in ledgers:
+            assert lg["outcome"] == "budget"
+            # The park between prefill retire and decode adoption is its
+            # own wall segment, and the three in-span segments still sum
+            # to the span's duration (exact in memory; as_dict's 6-decimal
+            # rounding allows 2e-6 of drift in the JSON view).
+            assert lg["handoff_s"] > 0.0
+            assert lg["prefill_s"] + lg["handoff_s"] + lg["decode_s"] \
+                == pytest.approx(lg["wall_s"], abs=5e-6)
+            assert lg["tokens_decoded"] == 4
+            assert lg["kv_block_seconds"] > 0.0
+        # Run-level aggregates ride the report.
+        agg = report.as_dict()["request_ledgers"]
+        assert agg["count"] == 3
+        assert agg["tokens_decoded_total"] == 12
+        assert agg["handoff_s_sum"] == pytest.approx(
+            sum(lg["handoff_s"] for lg in ledgers), rel=1e-6)
+
+    def test_report_omits_aggregates_when_disarmed(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        server = SlotServer(
+            params, CFG, slots=2, cache_len=CACHE_LEN,
+            prefill_chunk=BLOCK,
+        )
+        assert not obs.REQLOG.enabled
+        report = server.serve([
+            Request(uid=0, prompt=np.asarray(PROMPT, np.int32),
+                    max_new_tokens=2)
+        ])
+        assert report.results[0].ledger is None
+        assert "request_ledgers" not in report.as_dict()

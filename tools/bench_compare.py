@@ -142,6 +142,15 @@ _IGNORE_KEYS = frozenset((
     "peak_blocks_n1", "peak_blocks_family", "completions_n1",
     "completions_family", "tokens_family", "naive_pool_bytes_ratio",
     "fork_at",
+    # Request-telemetry record (ISSUE 16): ledger/flow bookkeeping
+    # counts and the gate's configured budget are workload shape, not
+    # performance — the guarded metrics of that family are
+    # tokens_per_sec_ratio (larger-better, via the tokens_per_sec
+    # substring) and ttft_p50_ratio (smaller-better, listed above),
+    # plus the per-arm tokens_per_sec / ttft_p50_s keys that classify
+    # through the standard rules.
+    "ledgers_recorded", "tokens_decoded_ledgered", "prefix_hit_ledgered",
+    "overhead_budget",
 ))
 
 

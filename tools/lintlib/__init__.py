@@ -180,8 +180,8 @@ def emit(out: List[Finding], src: Source, rule: str, node: ast.AST,
 
 # -- guard recognition (shared by obs-guard and lock-safety) ---------------
 
-#: The three telemetry instruments and the attribute that gates each.
-GUARD_KINDS = ("registry", "tracer", "flight")
+#: The telemetry instruments and the attribute that gates each.
+GUARD_KINDS = ("registry", "tracer", "flight", "reqlog")
 
 
 def _leaf_guard(expr: ast.AST) -> Optional[str]:
@@ -202,6 +202,8 @@ def _leaf_guard(expr: ast.AST) -> Optional[str]:
         return "registry"
     if attr == "enabled" and owner.endswith("FLIGHT"):
         return "flight"
+    if attr == "enabled" and owner.endswith("REQLOG"):
+        return "reqlog"
     if attr == "active" and owner.endswith("TRACER"):
         return "tracer"
     return None
@@ -377,8 +379,8 @@ def _load_passes() -> None:
     # Imported lazily so ``import tools.lintlib`` stays cheap and cannot
     # cycle; each module registers via @lint_pass at import.
     from tools.lintlib import (  # noqa: F401
-        donation, host_sync, ledger, lock_order, locks, mirror, obs_guard,
-        pallas, recompile,
+        donation, handoff, host_sync, ledger, lock_order, locks, mirror,
+        obs_guard, pallas, recompile,
     )
 
 

@@ -1,0 +1,153 @@
+"""handoff-transfer: every per-slot engine field crosses the handoff.
+
+``DisaggServer._adopt`` moves one parked request from a prefill slot to
+a decode slot by hand-copying the engine's per-slot ledgers — table row,
+private set, reservation, radix pins, sampling state, trace span.  The
+failure mode is silent: add a new ``self._slot_<x>`` ledger to
+``SlotServer`` (a feature PR touching only ``engine.py``) and every
+fused-engine test passes while the disagg pair decodes adopted requests
+against the NEW field's stale default — the exact class the ISSUE 16
+ledger/trace fields would have joined (a request's trace context and
+cost attribution must follow it across the handoff).
+
+Mechanics (the ``SLOTSERVER_DONATIONS`` verified-table idiom from the
+donation pass):
+
+- :data:`ADOPTED_SLOT_FIELDS` lists the per-slot fields ``_adopt`` must
+  assign on the decode side; :data:`ADOPT_EXEMPT` lists fields that
+  deliberately do NOT transfer, each with its reason.
+- ``engine.py``: every ``self._slot_*`` attribute the file assigns must
+  appear in one table or the other — a new per-slot ledger forces an
+  explicit adoption decision here, at lint time.
+- ``disagg.py``: ``_adopt`` must contain a decode-side assignment
+  (``dc.<field>[d] = ...``, ``dc.<field> = ...``, or the jax
+  ``dc.<field> = dc.<field>.at[d].set(...)`` shape) for every tabled
+  field.  The decode receiver is discovered from the ``pf, dc =
+  self.prefill, self.decode`` binding, not hard-coded.
+
+The reverse drift direction — a tabled name ``engine.py`` no longer
+builds — is pinned by ``tests/test_lint.py`` against the real tree (the
+donation pass's convention), so fixture snippets stay usable here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.lintlib import Finding, Source, dotted, emit, lint_pass
+
+RULE = "handoff-transfer"
+
+ENGINE = "tree_attention_tpu/serving/engine.py"
+DISAGG = "tree_attention_tpu/serving/disagg.py"
+
+#: Per-slot fields _adopt must assign on the decode worker.
+ADOPTED_SLOT_FIELDS = frozenset({
+    "_slot_req", "_slot_tokens", "_slot_admit", "_slot_wait",
+    "_slot_ttft", "_slot_max_tbt", "_slot_prefix_hit", "_slot_nblocks",
+    "_slot_private", "_slot_reserve", "_slot_nodes", "_slot_index",
+    "_slot_cum_lp", "_slot_shared", "_slot_clen", "_slot_state",
+    "_slot_span",
+})
+
+#: Per-slot fields that deliberately do NOT cross the handoff.
+ADOPT_EXEMPT: Dict[str, str] = {
+    # The fork-at parent's cached last-logits row: a parked request has
+    # exactly one committed token and no sampled branches yet, and the
+    # decode worker re-populates the row on its first dispatch.
+    "_slot_logits": "fork-at parent logits; repopulated at first decode",
+}
+
+
+def _engine_slot_fields(tree: ast.AST) -> Set[str]:
+    """Every ``self._slot_*`` attribute name assigned anywhere in the
+    file (init lists, ``.at[]`` rebinds, per-tick stores alike)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            while isinstance(t, ast.Subscript):
+                t = t.value
+            d = dotted(t)
+            if d and d.startswith("self._slot_"):
+                out.add(d[len("self."):])
+    return out
+
+
+def _decode_receiver(fn: ast.FunctionDef) -> Optional[str]:
+    """The local name bound to ``self.decode`` inside ``fn``."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Tuple) and isinstance(node.value,
+                                                       ast.Tuple):
+                for el, val in zip(t.elts, node.value.elts):
+                    if dotted(val) == "self.decode" \
+                            and isinstance(el, ast.Name):
+                        return el.id
+            elif dotted(node.value) == "self.decode" \
+                    and isinstance(t, ast.Name):
+                return t.id
+    return None
+
+
+def _adopted_fields(fn: ast.FunctionDef, recv: str) -> Set[str]:
+    """Field names assigned through ``recv`` inside ``fn`` — plain
+    attribute, subscripted row, or whole-array rebind targets."""
+    out: Set[str] = set()
+    prefix = recv + "."
+    for node in ast.walk(fn):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            while isinstance(t, ast.Subscript):
+                t = t.value
+            d = dotted(t)
+            if d and d.startswith(prefix):
+                out.add(d[len(prefix):])
+    return out
+
+
+@lint_pass(RULE)
+def check(src: Source) -> List[Finding]:
+    findings: List[Finding] = []
+    if src.path == ENGINE:
+        tabled = ADOPTED_SLOT_FIELDS | set(ADOPT_EXEMPT)
+        for name in sorted(_engine_slot_fields(src.tree) - tabled):
+            emit(findings, src, RULE, src.tree,
+                 f"per-slot field self.{name} is not in tools/lintlib/"
+                 f"handoff.py's ADOPTED_SLOT_FIELDS or ADOPT_EXEMPT — "
+                 f"decide whether DisaggServer._adopt must transfer it "
+                 f"(an adopted request otherwise decodes against the "
+                 f"field's stale default) and record the decision")
+        return findings
+    if src.path != DISAGG:
+        return []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "_adopt"):
+            continue
+        recv = _decode_receiver(node)
+        if recv is None:
+            emit(findings, src, RULE, node,
+                 "_adopt has no `... = self.decode` binding — the "
+                 "handoff-transfer pass cannot find the decode "
+                 "receiver to audit")
+            continue
+        missing = ADOPTED_SLOT_FIELDS - _adopted_fields(node, recv)
+        for name in sorted(missing):
+            emit(findings, src, RULE, node,
+                 f"_adopt never assigns {recv}.{name} — the adopted "
+                 f"request's decode slot keeps the field's stale value "
+                 f"(transfer it, or move it to ADOPT_EXEMPT in "
+                 f"tools/lintlib/handoff.py with a reason)")
+    return findings

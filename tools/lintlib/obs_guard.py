@@ -18,15 +18,23 @@ under the matching guard:
   ``obs.span(..., args=...)``, ``some_span.set(...)``) →
   ``obs.TRACER.active`` (the ``args=None if not obs.TRACER.active else
   {...}`` conditional counts — the allocating branch is guarded);
-- ``FLIGHT.record(rec)`` (and the ``rec`` build) → ``FLIGHT.enabled``.
+- ``FLIGHT.record(rec)`` (and the ``rec`` build) → ``FLIGHT.enabled``;
+- ``REQLOG.<seam>(...)`` ledger accumulation calls (ISSUE 16) →
+  ``REQLOG.enabled``: every seam call builds at least a kwargs dict
+  before the ledger's own early-return, so the zero-allocation
+  disabled path the telemetry bench asserts depends on the call-site
+  guard exactly like registry labels do.
 
 Scope: every module under ``tree_attention_tpu/`` EXCEPT ``obs/`` itself
 (the implementation is where the guards live; its internal early-returns
-use ``self.enabled``, which this pass has no business re-deriving).
-``serving/ingress.py`` (ISSUE 10) is therefore in scope automatically —
-its HTTP route/code counters and queue-depth gauge emit from handler
-threads, where an unguarded label allocation would tax every request
-even with telemetry off.
+use ``self.enabled``, which this pass has no business re-deriving) —
+with ONE exception since ISSUE 16: ``obs/reqlog.py`` is back IN scope,
+because the ledger is itself an instrumentation *consumer* (it emits a
+tracer instant at finish) and its emissions must honor the same guards
+as any call site. ``serving/ingress.py`` (ISSUE 10) is in scope
+automatically — its HTTP route/code counters and queue-depth gauge emit
+from handler threads, where an unguarded label allocation would tax
+every request even with telemetry off.
 """
 
 from __future__ import annotations
@@ -51,11 +59,18 @@ _METRIC_MUTS = {"inc", "dec", "observe", "set"}
 #: Call targets whose ``args=`` payload is a tracer emission.
 _TRACER_FNS = {"instant", "span", "counter_event"}
 
+#: Request-ledger accumulation seams — each builds a payload (kwargs
+#: dict, keyword defaults) before REQLOG's internal early-return, so the
+#: call site owns the guard.
+_REQLOG_SEAMS = {"open", "note", "blocks", "first_token", "park",
+                 "resume", "finish", "drop"}
+
 
 def _in_scope(path: str) -> bool:
     return (
-        path.startswith("tree_attention_tpu/")
-        and not path.startswith("tree_attention_tpu/obs/")
+        path == "tree_attention_tpu/obs/reqlog.py"
+        or (path.startswith("tree_attention_tpu/")
+            and not path.startswith("tree_attention_tpu/obs/"))
     )
 
 
@@ -169,6 +184,14 @@ class _Walker(GuardWalker):
                     emit(self.findings, self.src, RULE, e,
                          "FLIGHT.record(...) payload built without a "
                          "FLIGHT.enabled guard")
+                return
+        # REQLOG.<seam>(...) — ledger accumulation (ISSUE 16).
+        if (isinstance(fn, ast.Attribute) and fn.attr in _REQLOG_SEAMS):
+            d = dotted(fn.value) or ""
+            if d.split(".")[-1] == "REQLOG" and "reqlog" not in guards:
+                emit(self.findings, self.src, RULE, e,
+                     f"REQLOG.{fn.attr}(...) ledger call not under an "
+                     f"obs.REQLOG.enabled guard")
 
     def _check_payload(self, call: ast.Call, payload: Optional[ast.expr],
                        guards: frozenset, fname: str) -> None:

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome-trace JSONL sinks into ONE Perfetto file.
+
+Every process of a serving fleet writes its own ``--trace-events`` sink
+(the tracer truncates on open, so ranks must never share a path), and
+each stamps ``pid`` with its JAX process index — which is 0 for every
+*independent* serving process (router, replicas, disagg workers spawned
+as separate CLI runs). Loaded together those files collide onto one
+Perfetto row group and the fleet timeline is unreadable.
+
+This tool merges N sinks into one strict Chrome JSON file
+(``{"traceEvents": [...]}``) with:
+
+- **pid re-keying** — each input file owns a disjoint pid namespace:
+  ``(file, original pid)`` pairs map to fresh sequential pids, and every
+  new pid gets a ``process_name`` metadata event carrying the original
+  name plus the source file stem, so rows stay attributable;
+- **flow ids preserved** — the request flow events (``ph: s/t/f``,
+  ISSUE 16) carry ids derived from the request's 128-bit trace_id;
+  they are globally unique BY CONSTRUCTION and must merge untouched —
+  that is what draws the router → replica → worker arrows as one
+  connected chain across the re-keyed processes;
+- **timestamps untouched** — the tracer stamps ``ts`` from
+  ``CLOCK_MONOTONIC``, which is machine-wide: sinks captured on one
+  host share an epoch and need no skew correction. Merging sinks from
+  DIFFERENT hosts is out of scope (their monotonic epochs differ by
+  boot time).
+
+Malformed lines (a sink truncated by a crash mid-write) are skipped and
+counted, never fatal — a post-mortem merge must work on exactly the
+files a dead fleet left behind.
+
+Usage:
+    python tools/trace_merge.py -o merged.json r0.jsonl r1.jsonl ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Tuple
+
+
+def merge_traces(
+    inputs: List[Tuple[str, Iterable[str]]],
+) -> Tuple[Dict[str, Any], int]:
+    """Merge ``(label, jsonl-lines)`` pairs into one Chrome trace dict.
+
+    Returns ``({"traceEvents": [...]}, skipped_line_count)``. Events keep
+    their relative order per input; pids are re-keyed per (input,
+    original pid); flow/async ``id`` fields pass through untouched.
+    """
+    events: List[Dict[str, Any]] = []
+    pid_map: Dict[Tuple[str, Any], int] = {}
+    named: Dict[int, bool] = {}
+    skipped = 0
+    for label, lines in inputs:
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(ev, dict):
+                skipped += 1
+                continue
+            key = (label, ev.get("pid", 0))
+            pid = pid_map.get(key)
+            if pid is None:
+                pid = len(pid_map)
+                pid_map[key] = pid
+                named[pid] = False
+            ev["pid"] = pid
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                # Keep the original row name but make the source file
+                # visible — two replicas both called "host rank 0" must
+                # stay tellable apart after the merge.
+                orig = (ev.get("args") or {}).get("name", "")
+                ev["args"] = {"name": f"{orig} [{label}]" if orig
+                              else f"[{label}]"}
+                named[pid] = True
+            events.append(ev)
+    # Inputs whose sink lost its metadata line (crash-truncated head is
+    # impossible — the tracer writes it first — but be tolerant anyway)
+    # still get an attributable row name.
+    for (label, _orig), pid in pid_map.items():
+        if not named[pid]:
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"[{label}]"},
+            })
+    return {"traceEvents": events}, skipped
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+",
+                    help="per-process trace JSONL sinks to merge")
+    ap.add_argument("-o", "--out", required=True,
+                    help="merged Chrome JSON output path")
+    args = ap.parse_args(argv)
+    inputs: List[Tuple[str, Iterable[str]]] = []
+    for path in args.files:
+        try:
+            with open(path, "r") as fh:
+                lines = fh.readlines()
+        except OSError as e:
+            print(f"trace_merge: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        inputs.append((os.path.basename(path), lines))
+    merged, skipped = merge_traces(inputs)
+    with open(args.out, "w") as fh:
+        json.dump(merged, fh)
+    n = len(merged["traceEvents"])
+    print(f"trace_merge: {len(inputs)} file(s) -> {args.out} "
+          f"({n} event(s)"
+          + (f", {skipped} malformed line(s) skipped" if skipped else "")
+          + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

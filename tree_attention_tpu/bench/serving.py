@@ -2596,3 +2596,223 @@ def bench_serving_tiered_kv(
         "tiering": tier_rec,
         "int8_capacity": int8_rec,
     }
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: end-to-end request telemetry — overhead on vs all-off
+# ---------------------------------------------------------------------------
+
+
+def bench_serving_request_telemetry(
+    *,
+    replicas: int = 2,
+    slots: int = 2,
+    cache_len: int = 96,
+    n_requests: int = 24,
+    tenants: int = 4,
+    tenant_prefix_len: int = 32,
+    mean_gap_s: float = 0.005,
+    repeats: int = 3,
+    overhead_budget: float = 0.05,
+    cfg: Optional[TransformerConfig] = None,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """The telemetry-overhead record (ISSUE 16): the PR-11 fleet trace
+    replayed through the router with request telemetry ON (tracer +
+    request ledger armed, flow events and per-request cost ledgers
+    recorded end to end) vs ALL OFF, on the same engines.
+
+    Two claims, asserted live:
+
+    - **zero-allocation disabled path** — with telemetry off, a full
+      routed replay leaves the process-wide request ledger UNTOUCHED
+      (no live entries, no ring growth): the seams are guarded at every
+      call site (machine-checked by the obs-guard lint pass), so the
+      off arm pays attribute reads only.
+    - **<=5% overhead armed** — tokens/sec (on/off, best over
+      ``repeats``) stays >= ``1 - overhead_budget`` and TTFT p50
+      (on/off) <= ``1 + overhead_budget``. Arms interleave off/on per
+      repeat so drift hits both equally; every run replays the SAME
+      arrival/length schedule (one compile family, paid by a warmup)
+      with its own tenant-prefix population (cold prefix caches per
+      run, the fleet record's trick).
+
+    The on arm also proves the tentpole end to end: the trace sink must
+    contain the full flow chain (``s`` at the router, ``t`` at
+    adoption/admission, ``f`` at retire) and the ledger ring must hold
+    one finished ledger per request.
+    """
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+
+    from tree_attention_tpu.serving import Request as _Request
+    from tree_attention_tpu.serving.fleet import (
+        FleetSupervisor, LocalReplica,
+    )
+    from tree_attention_tpu.serving.router import FleetRouter
+
+    if obs.TRACER.active or obs.REQLOG.enabled:
+        # The overhead measurement needs a cold process: with telemetry
+        # already armed process-wide there is no "off" arm to compare.
+        return {"skipped": "telemetry already armed in this process"}
+
+    block = 16
+    cfg = cfg or serving_model_config(
+        max_seq_len=cache_len, vocab_size=128, d_model=64
+    )
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    kv_blocks = slots * (-(-cache_len // block)) + 24
+
+    def make_engine():
+        return SlotServer(
+            params, cfg, slots=slots, cache_len=cache_len,
+            prefill_chunk=block, prefix_cache=True, prefix_block=block,
+            kv_blocks=kv_blocks,
+        )
+
+    reps = [LocalReplica(f"r{i}", make_engine, max_queue=n_requests + 8,
+                         default_max_tokens=8, keepalive_s=0.1)
+            for i in range(replicas)]
+    router = FleetRouter(block=block, affinity=True, hysteresis=2)
+    sup = FleetSupervisor(reps, router=router, monitor_interval_s=0)
+    port = sup.start()
+    engines = sup.engines
+
+    def mt_trace(prefix_seed):
+        # Fixed `seed` => identical arrivals/lengths/tenant draws every
+        # run (ONE compile family, warmup pays it all); `prefix_seed`
+        # redraws the tenant prefix POPULATION so each run starts with
+        # a cold prefix cache for its own prefixes.
+        return heavy_tail_trace(
+            n_requests, cache_len=cache_len, mean_gap_s=mean_gap_s,
+            vocab_size=cfg.vocab_size, seed=seed + 2,
+            tenants=tenants, tenant_prefix_len=tenant_prefix_len,
+            prefix_seed=prefix_seed,
+        )
+
+    def run_once(prefix_seed) -> Dict[str, Any]:
+        res = replay_trace_http(port, mt_trace(prefix_seed))
+        for eng in engines:
+            _wait_engine_settled(eng)
+        served = sum(1 for r in res
+                     if r["finish_reason"] in ("stop", "length"))
+        assert served == n_requests, (
+            f"telemetry bench: only {served}/{n_requests} served"
+        )
+        ttfts = sorted(r["ttft_s"] for r in res
+                       if r["ttft_s"] is not None)
+        wall = max(r["done_s"] for r in res if r["done_s"] is not None)
+        tokens = sum(len(r["tokens"]) for r in res)
+        return {
+            "tokens_per_sec": round(tokens / wall, 2) if wall else 0.0,
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+            "wall_s": round(wall, 4),
+        }
+
+    # Warmup pays every jit compile (prefill buckets + step programs on
+    # each replica) before either arm is timed.
+    run_once(seed + 11)
+
+    tmp = _tempfile.mkdtemp(prefix="ta_reqlog_bench_")
+    off_runs: List[Dict[str, Any]] = []
+    on_runs: List[Dict[str, Any]] = []
+    on_sanity: Dict[str, Any] = {}
+    for rep in range(repeats):
+        # -- off arm: telemetry all off; the ledger must stay untouched.
+        before = obs.REQLOG.snapshot()
+        with obs.span(f"bench_telemetry:off{rep}", cat="bench"):
+            off_runs.append(run_once(seed + 100 + rep))
+        after = obs.REQLOG.snapshot()
+        assert (not after["enabled"] and after["live"] == []
+                and after["recent"] == [] and after == before), (
+            f"DISABLED-PATH VIOLATION: request ledger mutated with "
+            f"telemetry off: {after}"
+        )
+        # -- on arm: tracer + ledger armed, full flow chain recorded.
+        trace_path = _os.path.join(tmp, f"trace_r{rep}.jsonl")
+        obs.TRACER.start(trace_path)
+        obs.REQLOG.arm()
+        try:
+            with obs.span(f"bench_telemetry:on{rep}", cat="bench"):
+                on_runs.append(run_once(seed + 200 + rep))
+            snap = obs.REQLOG.snapshot()
+            ledgers = snap["recent"]
+            assert len(ledgers) == n_requests and snap["live"] == [], (
+                f"telemetry bench: {len(ledgers)} ledger(s) recorded "
+                f"for {n_requests} request(s), {len(snap['live'])} "
+                f"stuck live"
+            )
+            agg = obs.aggregate_ledgers(ledgers)
+            on_sanity = {
+                "ledgers_recorded": len(ledgers),
+                "tokens_decoded_ledgered":
+                    agg["tokens_decoded_total"],
+                "prefix_hit_ledgered": agg["prefix_hit_tokens_total"],
+            }
+        finally:
+            obs.REQLOG.disarm()
+            obs.TRACER.close()
+        flows = {"s": 0, "t": 0, "f": 0}
+        with open(trace_path) as fh:
+            for line in fh:
+                ph = _json.loads(line).get("ph")
+                if ph in flows:
+                    flows[ph] += 1
+        assert flows["s"] and flows["t"] and flows["f"], (
+            f"telemetry bench: incomplete flow chain in trace: {flows}"
+        )
+        on_sanity["flow_events"] = flows
+    sup.stop()
+
+    best_off = {
+        "tokens_per_sec": max(r["tokens_per_sec"] for r in off_runs),
+        "ttft_p50_s": min(r["ttft_p50_s"] for r in off_runs),
+    }
+    best_on = {
+        "tokens_per_sec": max(r["tokens_per_sec"] for r in on_runs),
+        "ttft_p50_s": min(r["ttft_p50_s"] for r in on_runs),
+    }
+    tok_ratio = round(
+        best_on["tokens_per_sec"] / best_off["tokens_per_sec"], 4
+    ) if best_off["tokens_per_sec"] else 0.0
+    ttft_ratio = round(
+        best_on["ttft_p50_s"] / best_off["ttft_p50_s"], 4
+    ) if best_off["ttft_p50_s"] else 0.0
+    assert tok_ratio >= 1.0 - overhead_budget, (
+        f"TELEMETRY OVERHEAD: tokens/sec on/off = {tok_ratio} "
+        f"< {1.0 - overhead_budget} "
+        f"(on {best_on['tokens_per_sec']}, off "
+        f"{best_off['tokens_per_sec']})"
+    )
+    assert ttft_ratio <= 1.0 + overhead_budget, (
+        f"TELEMETRY OVERHEAD: TTFT p50 on/off = {ttft_ratio} "
+        f"> {1.0 + overhead_budget} "
+        f"(on {best_on['ttft_p50_s']}s, off {best_off['ttft_p50_s']}s)"
+    )
+
+    log.info(
+        "request telemetry: tok/s on/off %.3f, ttft p50 on/off %.3f "
+        "(budget %.0f%%); %d ledger(s), flows %s; disabled path "
+        "allocation-free",
+        tok_ratio, ttft_ratio, overhead_budget * 100,
+        on_sanity.get("ledgers_recorded", 0),
+        on_sanity.get("flow_events"),
+    )
+    return {
+        "workload": {
+            "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                      "vocab": cfg.vocab_size},
+            "replicas": replicas, "slots_per_replica": slots,
+            "cache_len": cache_len, "n_requests": n_requests,
+            "tenants": tenants, "tenant_prefix_len": tenant_prefix_len,
+            "repeats": repeats, "overhead_budget": overhead_budget,
+        },
+        "off": {**best_off, "runs": off_runs,
+                "ledger_untouched": True},
+        "on": {**best_on, "runs": on_runs, **on_sanity},
+        "overhead": {
+            "tokens_per_sec_ratio": tok_ratio,
+            "ttft_p50_ratio": ttft_ratio,
+        },
+    }

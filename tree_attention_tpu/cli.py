@@ -715,6 +715,10 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         return 0
 
     server = make_engine()
+    if _METRICS_HTTP["server"] is not None:
+        # /slots introspection (ISSUE 16): the exporter started before
+        # the engine existed; wire it now.
+        _METRICS_HTTP["server"].attach_engine(server)
 
     if cfg.serve_http is not None:
         # The live ingress (ISSUE 10): serve real HTTP traffic until a
@@ -810,25 +814,34 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
     return 0
 
 
+#: The live telemetry exporter, when --metrics-port started one — the
+#: seam _run_serve uses to late-wire the engine behind /slots (the
+#: exporter starts before the engine exists).
+_METRICS_HTTP: dict = {"server": None}
+
+
 def _start_metrics_http(cfg: RunConfig):
     """Start the live telemetry endpoint, or return None without the flag.
 
-    /metrics needs the registry recording and /healthz + /flight need the
-    ring armed even when no exit sinks were asked for (a memory-only ring
-    serves both).
+    /metrics needs the registry recording, /healthz + /flight need the
+    ring armed, and /requests needs the request ledger armed even when no
+    exit sinks were asked for (memory-only rings serve all three).
     """
     if cfg.metrics_port is None:
         return None
     obs.REGISTRY.enable()
     if not obs.FLIGHT.enabled:
         obs.FLIGHT.arm()
+    if not obs.REQLOG.enabled:
+        obs.REQLOG.arm()
     from tree_attention_tpu.obs.http import MetricsHTTPServer
 
     server = MetricsHTTPServer(cfg.metrics_port)
     port = server.start()
+    _METRICS_HTTP["server"] = server
     log.info(
         "telemetry endpoint: http://127.0.0.1:%d/metrics "
-        "(/metrics.json /healthz /flight)", port,
+        "(/metrics.json /healthz /flight /requests /slots)", port,
     )
     return server
 

@@ -54,14 +54,27 @@ from tree_attention_tpu.obs.metrics import (  # noqa: F401
 )
 from tree_attention_tpu.obs.tracing import (  # noqa: F401
     SpanTracer,
+    TRACEPARENT_HEADER,
     TRACER,
+    flow,
+    flow_id,
     instant,
+    make_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
     span,
     traced,
 )
 from tree_attention_tpu.obs.flight import (  # noqa: F401
     FLIGHT,
     FlightRecorder,
+)
+from tree_attention_tpu.obs.reqlog import (  # noqa: F401
+    REQLOG,
+    ReqLog,
+    RequestLedger,
+    aggregate_ledgers,
 )
 from tree_attention_tpu.obs.slo import SLOMonitor  # noqa: F401
 
@@ -126,6 +139,11 @@ def configure(
         REGISTRY.enable()
     if flight_out:
         FLIGHT.arm(_rank_suffixed(flight_out))
+    if metrics_out or trace_events:
+        # The request ledger rides whichever instrument is on: its
+        # /requests view backs the metrics plane and its finish instant
+        # lands in the trace; it has no sink file of its own.
+        REQLOG.arm()
 
 
 def shutdown() -> Dict[str, Any]:
@@ -154,6 +172,7 @@ def shutdown() -> Dict[str, Any]:
     REGISTRY.disable()
     TRACER.close()
     FLIGHT.disarm()
+    REQLOG.disarm()
     return out
 
 

@@ -35,7 +35,66 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+# -- W3C-traceparent-style request context -----------------------------------
+#
+# One request = one trace_id, minted at the FIRST ingress that sees it
+# (router-fronted fleets: the router's relay forwards the header and the
+# replica ingress ADOPTS instead of minting). Each process that handles the
+# request stamps its own span_id. The wire format is the W3C traceparent
+# header, ``00-<32 hex trace_id>-<16 hex span_id>-01`` — close enough that
+# off-the-shelf middleboxes pass it through untouched.
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+def new_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """64-bit random span id, 16 lowercase hex chars."""
+    return os.urandom(8).hex()
+
+
+def make_traceparent(trace_id: str, span_id: str) -> str:
+    """Serialize to the W3C header value (version 00, sampled flag set)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
+    """Parse a traceparent header value → ``(trace_id, span_id)``.
+
+    Returns ``None`` for anything malformed (wrong field count, wrong
+    lengths, non-hex, all-zero ids) — the caller mints a fresh context
+    instead of propagating garbage.
+    """
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def flow_id(trace_id: str) -> int:
+    """Chrome-trace flow ``id`` for a trace: the low 53 bits of the
+    trace_id (kept under 2**53 so JSON consumers that parse numbers as
+    doubles — Perfetto's legacy JSON importer among them — round-trip it
+    exactly)."""
+    return int(trace_id[-14:], 16) & ((1 << 53) - 1)
 
 
 class _NoopSpan:
@@ -182,6 +241,28 @@ class SpanTracer:
             "pid": self._pid, "tid": self._tid(), "args": values,
         })
 
+    def flow(self, phase: str, fid: int, name: str = "request",
+             cat: str = "serving") -> None:
+        """Chrome-trace flow event binding cross-process arrows.
+
+        ``phase`` is ``"s"`` (start), ``"t"`` (step), or ``"f"`` (finish);
+        ``fid`` is the shared flow id (:func:`flow_id` of the trace_id).
+        Flow points bind to whichever slice encloses their ``ts`` on this
+        pid/tid — emit them INSIDE the span that should anchor the arrow.
+        Perfetto then draws one connected arrow chain across every process
+        file merged into the load (``tools/trace_merge.py``).
+        """
+        if not self.active:
+            return
+        ev: Dict[str, Any] = {
+            "name": name, "cat": cat, "ph": phase, "id": fid,
+            "ts": time.monotonic_ns() // 1000,
+            "pid": self._pid, "tid": self._tid(),
+        }
+        if phase == "f":
+            ev["bp"] = "e"  # bind the finish to the enclosing slice
+        self._emit(ev)
+
     # -- internals --------------------------------------------------------
 
     def _tid(self) -> int:
@@ -235,6 +316,12 @@ def span(name: str, cat: str = "host",
 def instant(name: str, cat: str = "host",
             args: Optional[Dict[str, Any]] = None) -> None:
     TRACER.instant(name, cat, args)
+
+
+def flow(phase: str, fid: int, name: str = "request",
+         cat: str = "serving") -> None:
+    """Module-level shorthand for ``TRACER.flow``."""
+    TRACER.flow(phase, fid, name, cat)
 
 
 def traced(name: Optional[str] = None, cat: str = "host") -> Callable:

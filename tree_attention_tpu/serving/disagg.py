@@ -414,6 +414,18 @@ class DisaggServer:
             out["host_blocks_used"] = self.host_pool.used
         return out
 
+    def slots_snapshot(self) -> List[Dict[str, Any]]:
+        """The pair's ``/slots`` view (ISSUE 16): both workers' rows,
+        labeled — a parked handoff shows as prefill state ``handoff``
+        until a decode row adopts it."""
+        out: List[Dict[str, Any]] = []
+        for worker, eng in (("prefill", self.prefill),
+                            ("decode", self.decode)):
+            for row in eng.slots_snapshot():
+                row["worker"] = worker
+                out.append(row)
+        return out
+
     # -- the zero-copy handoff ---------------------------------------------
 
     def _relay_pool(self, src: SlotServer, dst: SlotServer) -> None:
@@ -518,6 +530,14 @@ class DisaggServer:
                 "rid": req.uid, "tick": tick, "from_slot": p,
                 "to_slot": d, "blocks": nb, "kv_bytes_moved": 0,
             })
+            if req.trace is not None:
+                # Step point of the request's cross-process flow at the
+                # prefill→decode adoption (ISSUE 16): the trace context
+                # rides the Request object across the handoff.
+                obs.flow("t", obs.flow_id(req.trace[0]))
+        if obs.REQLOG.enabled:
+            # Close the ledger's handoff segment (parked → adopted).
+            obs.REQLOG.resume(req.uid)
 
     # -- the split tick loop ----------------------------------------------
 
@@ -873,6 +893,8 @@ class DisaggServer:
                                         "ttft_s": round(
                                             pf._slot_ttft[i], 6),
                                     })
+                            if obs.REQLOG.enabled:
+                                obs.REQLOG.first_token(req.uid, now=now2)
                             if req.eos_id is not None \
                                     and first == req.eos_id:
                                 pf._retire(i, tick, OUTCOME_EOS, results)
@@ -889,6 +911,10 @@ class DisaggServer:
                                         "handoff_queued", cat="serving",
                                         args={"rid": req.uid, "slot": i,
                                               "tick": tick})
+                                if obs.REQLOG.enabled:
+                                    # Open the ledger's handoff segment:
+                                    # parked until a decode slot adopts.
+                                    obs.REQLOG.park(req.uid)
                 dt_pf = time.monotonic() - tp0
                 prefill_s += dt_pf
                 # CPU-proxy attribution: the serialized prefill section
@@ -1228,4 +1254,7 @@ class DisaggServer:
             kv=kv_snap,
             spec=spec_snap,
             handoff=handoff_snap,
+            requests=obs.aggregate_ledgers(
+                [r.ledger for r in results if r.ledger is not None]
+            ) or {},
         )

@@ -306,6 +306,14 @@ class Request:
     on_branch_token: Optional[Callable[[int, int], None]] = None
     on_branch_finish: Optional[
         Callable[[int, "RequestResult"], None]] = None
+    # Cross-process trace context (ISSUE 16): ``(trace_id, parent
+    # span_id)`` adopted from the ingress's W3C-traceparent header (or
+    # minted there). Rides the Request object through every hop —
+    # router relay, replica ingress, disagg prefill→decode adoption —
+    # so one Perfetto load of the merged per-process traces shows the
+    # request as one connected flow. ``None`` = untraced (direct
+    # engine callers; nothing is emitted or allocated).
+    trace: Optional[Tuple[str, str]] = None
 
 
 @dataclasses.dataclass
@@ -333,6 +341,12 @@ class RequestResult:
     # — best-of-n's server-side selection key (0.0 under speculation,
     # which is greedy-only and tracks no logprobs).
     cum_logprob: float = 0.0
+    # Finished request-cost ledger (ISSUE 16): the dict
+    # ``obs.REQLOG.finish`` returned at retire — wall segments, token
+    # and KV-block attribution, trace ids. ``None`` when the ledger is
+    # disarmed, and on every branch after the first for n>1 families
+    # (the ledger is per-uid, closed once).
+    ledger: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -386,6 +400,11 @@ class ServeReport:
     # queue peak, blocks transferred, kv_bytes_moved (pinned 0 in-process)
     # — empty for a fused engine.
     handoff: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Per-request ledger aggregates for THIS run (ISSUE 16):
+    # ``obs.aggregate_ledgers`` over the finished ledgers attached to
+    # results — phase-wall sums/p50s, token and KV-block totals. Empty
+    # when the request ledger is disarmed.
+    requests: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -438,6 +457,7 @@ class ServeReport:
             **({"kv": self.kv} if self.kv else {}),
             **({"spec": self.spec} if self.spec else {}),
             **({"handoff": self.handoff} if self.handoff else {}),
+            **({"request_ledgers": self.requests} if self.requests else {}),
         }
 
 
@@ -1673,6 +1693,28 @@ class SlotServer:
             pass
         return out
 
+    def slots_snapshot(self) -> List[Dict[str, Any]]:
+        """Per-slot live view for the obs server's ``/slots`` endpoint
+        (ISSUE 16): state, occupant uid/branch, generated length, and
+        committed cache length. Called from HTTP handler threads while
+        the engine thread mutates the arrays — every read here is one
+        GIL-atomic list index (ints, strings, a Request ref), so the
+        worst case is a snapshot one tick stale, never a torn value."""
+        out: List[Dict[str, Any]] = []
+        for i in range(self.slots):
+            req = self._slot_req[i]
+            out.append({
+                "slot": i,
+                "state": self._slot_state[i],
+                "uid": None if req is None else req.uid,
+                "index": self._slot_index[i] if req is not None else 0,
+                "tokens": len(self._slot_tokens[i]),
+                "clen": self._slot_clen[i],
+                **({"nblocks": self._slot_nblocks[i]}
+                   if self._paged else {}),
+            })
+        return out
+
     # -- per-request callbacks (engine thread) -----------------------------
 
     def _deliver_token(self, req: Request, index: int, tok: int) -> None:
@@ -1776,6 +1818,15 @@ class SlotServer:
     # (the disaggregated pair's workers — a family would need slots on
     # both sides of the handoff).
     _fork_ok = True
+
+    # Admission-scoped host-tier attribution scratch (ISSUE 16): counts
+    # accumulated while _admit runs — prefix-path restores by
+    # _paged_hit, demote flushes a dry allocator forces mid-admission —
+    # and folded into the request's ledger once it opens at the end of
+    # _admit. Plain ints, engine-thread only.
+    _admitting = False
+    _adm_restored = 0
+    _adm_demoted = 0
 
     def _validate(self, req: Request) -> None:
         plen = len(req.prompt)
@@ -1921,6 +1972,7 @@ class SlotServer:
         if not self._paged:
             return
         need = -(-tokens_needed // self.kv_block)
+        grew = self._slot_nblocks[slot] < need
         while self._slot_nblocks[slot] < need:
             assert self._slot_reserve[slot] > 0, (
                 f"slot {slot} outgrew its block reservation"
@@ -1931,6 +1983,12 @@ class SlotServer:
             self._slot_private[slot].add(bid)
             self._slot_nblocks[slot] += 1
             self._table_dirty = True
+        if grew and obs.REQLOG.enabled:
+            # Re-integrate the ledger's device-block-seconds at the new
+            # block count (once per block boundary, not per token).
+            rq = self._slot_req[slot]
+            if rq is not None:
+                obs.REQLOG.blocks(rq.uid, self._slot_nblocks[slot])
 
     def _sync_table(self) -> None:
         """Push the host block table to the device when it changed — the
@@ -1990,6 +2048,10 @@ class SlotServer:
         hp.commit(rows, *out)  # the D2H fetch happens inside commit
         for b in bids:
             self._pool.free_demoted(b)
+        if self._admitting:
+            # A dry allocator forced this flush mid-admission: charge
+            # the demotions to the admitting request's ledger scratch.
+            self._adm_demoted += len(bids)
         if obs.TRACER.active:
             obs.instant("kv_demote_flush", cat="serving", args={
                 "blocks": len(bids),
@@ -2003,6 +2065,9 @@ class SlotServer:
         # BEFORE any prefill work runs (prefill, including a first-bucket
         # jit compile, is service time, not queueing).
         waited = max(time.monotonic() - visible_at, 0.0)
+        self._admitting = True
+        self._adm_restored = 0
+        self._adm_demoted = 0
         self._slot_req[slot] = req
         self._slot_tokens[slot] = []
         self._slot_admit[slot] = (tick, visible_at)
@@ -2059,6 +2124,7 @@ class SlotServer:
                 "prompt_len": len(req.prompt),
                 **({"prefix_hit_len": matched}
                    if self._prefix is not None else {}),
+                **({"trace_id": req.trace[0]} if req.trace else {}),
             },
         )
         if obs.TRACER.active:
@@ -2066,6 +2132,28 @@ class SlotServer:
                 "rid": req.uid, "slot": slot, "tick": tick,
                 "queue_wait_s": round(waited, 6),
             })
+            if req.trace is not None:
+                # Step point of the request's cross-process flow; binds
+                # to the slice enclosing this instant (ISSUE 16).
+                obs.flow("t", obs.flow_id(req.trace[0]))
+        if obs.REQLOG.enabled:
+            obs.REQLOG.open(
+                req.uid,
+                trace_id=req.trace[0] if req.trace else "",
+                span_id=obs.new_span_id(),
+                parent_span_id=req.trace[1] if req.trace else "",
+                prompt_tokens=len(req.prompt),
+                prefix_hit_tokens=matched,
+                arrival_tick=req.arrival_tick,
+                admit_tick=tick,
+                queue_wait_s=waited,
+                nblocks=self._slot_nblocks[slot] if self._paged else 0,
+            )
+            if self._adm_restored or self._adm_demoted:
+                obs.REQLOG.note(req.uid,
+                                host_restores=self._adm_restored,
+                                host_demotes=self._adm_demoted)
+        self._admitting = False
         if self.admission == "chunked":
             self._prefill_pos[slot] = matched
             self._slot_state[slot] = "prefill"
@@ -2188,6 +2276,7 @@ class SlotServer:
         if self._host_pool is not None:
             restored = self._restore_demoted(slot, nodes)
             self._tick_restored += restored
+            self._adm_restored += restored
         for j, node in enumerate(nodes):
             self._host_table[slot, j] = node.block_id
         self._slot_nblocks[slot] = matched // self.kv_block
@@ -2606,6 +2695,8 @@ class SlotServer:
                 "copied_blocks": int(need_copy),
                 "at_tokens": len(tokens_prefix),
             })
+        if obs.REQLOG.enabled and nshare:
+            obs.REQLOG.note(req.uid, fork_shared_blocks=nshare)
 
     def _fork_live(self, uid: int, tick: int,
                    pend_uids: Set[int]) -> str:
@@ -2878,6 +2969,9 @@ class SlotServer:
                     "proposed": m, "accepted": len(kept),
                     "committed": n_emit,
                 })
+            if obs.REQLOG.enabled and m:
+                obs.REQLOG.note(req.uid, spec_proposed=m,
+                                spec_accepted=len(kept))
             if outcome is not None:
                 self._retire(i, tick, outcome, results)
                 continue
@@ -3044,8 +3138,18 @@ class SlotServer:
                     "rid": req.uid, "slot": slot, "tick": tick,
                     "outcome": outcome,
                 })
+                if req.trace is not None:
+                    # Finish point of the cross-process flow — emitted
+                    # while the request span is still open so the arrow
+                    # binds to it (bp:"e").
+                    obs.flow("f", obs.flow_id(req.trace[0]))
             span.__exit__(None, None, None)
             self._slot_span[slot] = None
+        if obs.REQLOG.enabled:
+            result.ledger = obs.REQLOG.finish(
+                req.uid, outcome=outcome, finish_tick=tick,
+                tokens_decoded=len(result.tokens), now=now,
+            )
         self._slot_req[slot] = None
         self._slot_tokens[slot] = []
         self._slot_state[slot] = "free"
@@ -3710,6 +3814,8 @@ class SlotServer:
                                         "ttft_s": round(
                                             self._slot_ttft[i], 6),
                                     })
+                            if obs.REQLOG.enabled:
+                                obs.REQLOG.first_token(req.uid, now=now2)
                             # Family forks happen HERE — before the
                             # parent's EOS/budget check, so even a
                             # one-token parent yields n independent
@@ -3985,4 +4091,7 @@ class SlotServer:
             prefix=prefix_snap,
             kv=kv_snap,
             spec=spec_snap,
+            requests=obs.aggregate_ledgers(
+                [r.ledger for r in results if r.ledger is not None]
+            ) or {},
         )

@@ -43,6 +43,7 @@ invariant linter's lock-safety pass scopes this file.
 
 from __future__ import annotations
 
+import itertools
 import json
 import math
 import queue
@@ -66,6 +67,13 @@ from tree_attention_tpu.utils.httpd import DaemonHTTPServer
 from tree_attention_tpu.utils.logging import get_logger
 
 log = get_logger("serving.ingress")
+
+# Request uids are minted process-wide, not per ingress: co-located
+# replicas (LocalReplica fleets, disagg pairs under one router) all feed
+# the one process-global request ledger, which keys by uid — per-ingress
+# counters would collide there and silently drop ledgers (ISSUE 16).
+# `next()` on an itertools.count is atomic under the GIL.
+_UID_COUNTER = itertools.count()
 
 # Ingress-plane metrics: HTTP outcomes by route/code (backpressure 429s
 # and drain 503s live here — they never became engine requests), SSE
@@ -197,7 +205,6 @@ class IngressServer(DaemonHTTPServer):
         # the drain, the exact failure mode the obs crash-path rule
         # exists for.
         self._lock = threading.RLock()
-        self._next_uid = 0
         self._queued = 0  # submitted, first token not yet streamed
         self._draining = False
         self._engine_thread: Optional[threading.Thread] = None
@@ -366,8 +373,7 @@ class IngressServer(DaemonHTTPServer):
             else:
                 self._queued += 1
                 depth, verdict = self._queued, 200
-                uid = self._next_uid
-                self._next_uid += 1
+                uid = next(_UID_COUNTER)
         if verdict == 503:
             self._reply_counted(
                 req, "completions", 503,
@@ -388,6 +394,18 @@ class IngressServer(DaemonHTTPServer):
             return
         if obs.REGISTRY.enabled:
             _QUEUE_DEPTH.set(depth)
+
+        # Trace context (ISSUE 16): adopt the client's W3C traceparent
+        # when one arrives (the router relays its own — replica spans
+        # join the fleet trace), mint a fresh one otherwise (direct
+        # clients get a trace too). The pair rides the Request through
+        # admission, disagg handoff, and retirement.
+        parsed = obs.parse_traceparent(
+            req.headers.get(obs.TRACEPARENT_HEADER, ""))
+        adopted = parsed is not None
+        if parsed is None:
+            parsed = (obs.new_trace_id(), obs.new_span_id())
+        trace_id, parent_span = parsed
 
         events: "queue.Queue" = queue.Queue()
         deadline = body.get("deadline_s", self.default_deadline_s)
@@ -411,6 +429,7 @@ class IngressServer(DaemonHTTPServer):
             top_k=body.get("top_k"),
             seed=body.get("seed"),
             fork_at=body.get("fork_at"),
+            trace=(trace_id, parent_span),
             on_branch_token=lambda i, t: events.put(("token", (i, t))),
             on_branch_finish=lambda i, res: events.put(
                 ("finish", (i, res))),
@@ -425,7 +444,20 @@ class IngressServer(DaemonHTTPServer):
                 deq_state[0] = True
                 self._dequeued()
 
-        if not self.source.submit(request):
+        if obs.TRACER.active:
+            # A named submit slice anchors the flow point: Perfetto
+            # binds flow arrows to the slice enclosing their timestamp.
+            # "s" starts a new flow chain (direct client, trace minted
+            # here); "t" is a step on the chain the upstream hop (the
+            # router's relay span) already started.
+            with obs.span("ingress_submit", cat="serving",
+                          args={"rid": uid, "trace_id": trace_id,
+                                "adopted": adopted}):
+                obs.flow("t" if adopted else "s", obs.flow_id(trace_id))
+                submitted = self.source.submit(request)
+        else:
+            submitted = self.source.submit(request)
+        if not submitted:
             dequeue_once()
             self._reply_counted(
                 req, "completions", 503,
@@ -661,6 +693,11 @@ class IngressServer(DaemonHTTPServer):
         dequeue_once()
         finished.sort(key=lambda r: r.index)
         best = finished[0]
+        # The per-request cost ledger (ISSUE 16) closes with the FIRST
+        # branch the engine retires; later branches of an n>1 family
+        # carry None.
+        ledger = next(
+            (r.ledger for r in finished if r.ledger is not None), None)
         code = 200 if any(
             r.tokens or FINISH_REASONS.get(r.outcome, r.outcome)
             in ("stop", "length") for r in finished
@@ -678,6 +715,7 @@ class IngressServer(DaemonHTTPServer):
                 "prompt_tokens": best.prompt_len,
                 "completion_tokens": sum(len(r.tokens) for r in finished),
                 "prefix_hit_tokens": best.prefix_hit_tokens,
+                **({"ledger": ledger} if ledger is not None else {}),
             },
         }, indent=2), "application/json")
 
@@ -723,6 +761,10 @@ def _sse_finish(uid: int, result: RequestResult,
             # prompt the replica's radix cache actually served — the
             # router's approximate-tree feedback signal.
             "prefix_hit_tokens": result.prefix_hit_tokens,
+            # Per-request cost ledger (ISSUE 16); present only when the
+            # ledger is armed, and only on the branch that closed it.
+            **({"ledger": result.ledger}
+               if result.ledger is not None else {}),
         },
     }) + "\n\n").encode()
 

@@ -37,6 +37,21 @@ fix this module implements:
   registry plus every replica's scrape (replicas registered with a
   ``metrics_url``) rewritten under a ``replica="<name>"`` label, so one
   Prometheus target sees the whole fleet.
+- **Telemetry federation** (ISSUE 16) — ``GET /requests`` merges the
+  request-ledger snapshots (the router process's own — which is where
+  in-process replicas record — labeled ``replica="local"``, plus every
+  replica obs endpoint derived from its ``metrics_url``), each entry
+  gaining a ``replica`` label; ``GET /healthz`` rolls up tick liveness
+  so a WEDGED replica (engine loop stopped, HTTP thread still
+  answering) fails the FLEET check, not just its own process's; ``GET
+  /flight`` returns the router's ring plus every reachable replica's.
+- **Trace propagation** (ISSUE 16) — the router is the fleet's trace
+  ingress: it adopts the client's W3C ``traceparent`` (or mints one),
+  forwards it on the relayed POST with the routing span as parent, and
+  emits the Chrome-trace flow *start* point inside its routing slice —
+  the replica's ingress/engine/disagg hops add step points and the
+  retire seam finishes the arrow, so ONE Perfetto load of the merged
+  per-process trace files shows router → replica → workers connected.
 
 The router is a *pass-through*: it speaks the same OpenAI-compatible
 ``POST /v1/completions`` shape as the ingress and relays SSE events
@@ -445,11 +460,25 @@ class FleetRouter(DaemonHTTPServer):
         elif method == "GET" and path == "/metrics":
             self.reply(req, 200, self.federated_metrics(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif method == "GET" and path == "/requests":
+            self.reply(req, 200,
+                       json.dumps(self.federated_requests(), indent=2),
+                       "application/json")
+        elif method == "GET" and path == "/healthz":
+            code, body = self.federated_health()
+            self.reply(req, code, json.dumps(body, indent=2),
+                       "application/json")
+        elif method == "GET" and path == "/flight":
+            self.reply(req, 200,
+                       json.dumps(self.federated_flight(), indent=2,
+                                  default=str),
+                       "application/json")
         elif method == "GET" and path == "/":
             self.reply(
                 req, 200,
                 "tree_attention_tpu serving router: "
-                "POST /v1/completions  GET /router/stats  GET /metrics\n",
+                "POST /v1/completions  GET /router/stats  GET /metrics  "
+                "GET /requests  GET /healthz  GET /flight\n",
                 "text/plain",
             )
         else:
@@ -502,6 +531,80 @@ class FleetRouter(DaemonHTTPServer):
         fed = federate_metrics(sections)
         return own + ("\n" + fed if fed else "")
 
+    # -- telemetry federation (ISSUE 16) ----------------------------------
+
+    def _obs_targets(self) -> List[Tuple[str, str, str]]:
+        """(name, obs-base-url, state) per replica that exports one —
+        derived from the registered ``metrics_url`` by stripping its
+        ``/metrics`` path (the obs server mounts every endpoint on one
+        port). In-process replicas have none: they record into THIS
+        process's singletons, covered by the ``local`` section."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        out = []
+        for r in reps:
+            if r.metrics_url and r.metrics_url.endswith("/metrics"):
+                out.append((r.name, r.metrics_url[:-len("/metrics")],
+                            r.state))
+        return out
+
+    def federated_requests(self) -> Dict[str, Any]:
+        """Fleet-wide request-ledger view: every entry labeled with the
+        replica it ran on (``local`` = this process — where LocalReplica
+        engines record)."""
+        out: Dict[str, Any] = {"live": [], "recent": []}
+        local = obs.REQLOG.snapshot()
+        for section in ("live", "recent"):
+            for entry in local[section]:
+                entry["replica"] = "local"
+                out[section].append(entry)
+        for name, base, _state in self._obs_targets():
+            snap = _get_json(f"{base}/requests", timeout=2.0)
+            if not isinstance(snap, dict):
+                continue
+            for section in ("live", "recent"):
+                for entry in snap.get(section) or []:
+                    entry["replica"] = name
+                    out[section].append(entry)
+        return out
+
+    def federated_health(self) -> Tuple[int, Dict[str, Any]]:
+        """Fleet tick-liveness roll-up: 503 iff this process is stalled,
+        any replica obs endpoint reports stalled (a WEDGED engine whose
+        HTTP thread still answers — the failure /healthz exists to
+        catch), or a replica the router still considers up has an
+        unreachable obs endpoint (process gone mid-scrape)."""
+        from tree_attention_tpu.obs.http import flight_health
+
+        code, own = flight_health(obs.FLIGHT)
+        body: Dict[str, Any] = {"router": own, "replicas": {}}
+        worst = code
+        for name, base, state in self._obs_targets():
+            snap = _get_json(f"{base}/healthz", timeout=2.0,
+                             accept_errors=True)
+            if not isinstance(snap, dict):
+                snap = {"status": "unreachable"}
+                if state == "up":
+                    worst = 503
+            elif snap.get("status") == "stalled":
+                worst = 503
+            snap["state"] = state
+            body["replicas"][name] = snap
+        body["status"] = "ok" if worst == 200 else "stalled"
+        return worst, body
+
+    def federated_flight(self) -> Dict[str, Any]:
+        """The router process's flight ring plus every reachable
+        replica's — the fleet-wide live post-mortem."""
+        out: Dict[str, Any] = {"router": obs.FLIGHT.snapshot(),
+                               "replicas": {}}
+        for name, base, _state in self._obs_targets():
+            snap = _get_json(f"{base}/flight", timeout=2.0)
+            out["replicas"][name] = (
+                snap if isinstance(snap, dict) else {"error": "unreachable"}
+            )
+        return out
+
     # -- the proxy --------------------------------------------------------
 
     def _completions(self, req: BaseHTTPRequestHandler) -> None:
@@ -529,6 +632,13 @@ class FleetRouter(DaemonHTTPServer):
                 "type": "invalid_request"}}), "application/json")
             return
         stream = bool(body.get("stream", True))
+        # Trace context (ISSUE 16): the router is the first hop that
+        # traces, so it owns the trace_id — adopt the client's
+        # traceparent when one arrived, mint otherwise. Each relay
+        # attempt below forwards it with a fresh routing span id.
+        parsed = obs.parse_traceparent(
+            req.headers.get(obs.TRACEPARENT_HEADER, ""))
+        trace_id = parsed[0] if parsed is not None else obs.new_trace_id()
         orig_deadline = body.get("deadline_s")
         t0 = time.monotonic()
         tried: Set[str] = set()
@@ -548,7 +658,7 @@ class FleetRouter(DaemonHTTPServer):
                     orig_deadline - (time.monotonic() - t0), 1e-3
                 )
             verdict = self._relay_one(relay, name, host, port, body,
-                                      prompt, reason, predicted)
+                                      prompt, reason, predicted, trace_id)
             if verdict == "done":
                 return
             # "retry": the replica refused (503/shed/dead) before any
@@ -570,18 +680,33 @@ class FleetRouter(DaemonHTTPServer):
 
     def _relay_one(self, relay: "_ClientRelay", name: str, host: str,
                    port: int, body: Dict[str, Any], prompt,
-                   reason: str, predicted: int) -> str:
+                   reason: str, predicted: int, trace_id: str) -> str:
         """Proxy one attempt to one replica; returns 'done' | 'retry'."""
         import http.client
 
+        # Routing span: a fresh span id per attempt (a failover retry is
+        # its OWN hop in the trace), forwarded as the replica's parent.
+        # The flow "s" point inside the slice starts the cross-process
+        # arrow the replica's adopt points continue.
+        rspan = obs.new_span_id()
+        if obs.TRACER.active:
+            with obs.span("route_relay", cat="serving",
+                          args={"replica": name, "reason": reason,
+                                "trace_id": trace_id,
+                                "predicted_match": predicted}):
+                obs.flow("s", obs.flow_id(trace_id))
         hit_tokens: Optional[int] = None
         conn = http.client.HTTPConnection(
             host, port, timeout=self.replica_timeout_s
         )
         try:
             try:
-                conn.request("POST", "/v1/completions", json.dumps(body),
-                             {"Content-Type": "application/json"})
+                conn.request(
+                    "POST", "/v1/completions", json.dumps(body),
+                    {"Content-Type": "application/json",
+                     obs.TRACEPARENT_HEADER: obs.make_traceparent(
+                         trace_id, rspan)},
+                )
                 resp = conn.getresponse()
             except OSError:
                 # Connection refused/reset: the replica process is gone
@@ -811,6 +936,28 @@ def _scrape(url: str, timeout: float) -> Optional[str]:
         with urllib.request.urlopen(url, timeout=timeout) as r:
             return r.read().decode("utf-8", "replace")
     except OSError:
+        return None
+
+
+def _get_json(url: str, timeout: float,
+              accept_errors: bool = False) -> Optional[Any]:
+    """Best-effort GET + JSON parse of one replica obs endpoint.
+    ``accept_errors`` keeps non-2xx BODIES (a 503 /healthz still carries
+    its status JSON — that verdict is the payload, not a failure)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        if not accept_errors:
+            return None
+        try:
+            return json.loads(e.read())
+        except (OSError, ValueError):
+            return None
+    except (OSError, ValueError):
         return None
 
 
