@@ -760,6 +760,63 @@ def _serving_telemetry_record():
     return bench_serving_request_telemetry()
 
 
+def _serving_seq_sharded_record():
+    """Sequence-sharded paged serving (ISSUE 18): max servable context
+    at EQUAL per-device pool bytes, mesh=1 vs a mesh=2 pool range-
+    partitioned by --kv-shard seq — both capacity boundaries measured
+    (the pool-filling request streams, one block more is rejected),
+    TTFT/TBT p50 on a common trace parity-gated against a mesh=2
+    replicated oracle, and the decode merge asserted to cost EXACTLY
+    three collectives (pmax + 2x psum, the tree monoid arXiv:2408.04093)
+    via the accounting counters. CPU proxy on the emulated 2-device
+    mesh; the capacity-scaling structure transfers. See
+    tree_attention_tpu/bench/serving.py.
+
+    Needs >= 2 CPU devices, which requires the host-device-count XLA
+    flag BEFORE jax init — when this process can't provide that (TPU
+    backend, or a single-device CPU init), the record runs in a clean
+    CPU subprocess like the comparator benches."""
+    import jax
+
+    if jax.default_backend() == "cpu" and len(jax.devices()) >= 2:
+        from tree_attention_tpu.bench.serving import (
+            bench_serving_seq_sharded,
+        )
+
+        return bench_serving_seq_sharded()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TA_METRICS_OUT", None)
+    env.pop("TA_TRACE_EVENTS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=2".strip()
+        )
+    code = (
+        "import json\n"
+        "from tree_attention_tpu.bench.serving import "
+        "bench_serving_seq_sharded\n"
+        "print(json.dumps(bench_serving_seq_sharded()))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"seq-sharded subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}"
+        )
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError("seq-sharded subprocess printed no JSON")
+
+
 def _tpu_reachable(timeout_s: int = 240):
     """Probe the TPU in a subprocess so a wedged tunnel cannot hang the bench.
 
@@ -1000,6 +1057,7 @@ def _run_suite() -> None:
     run("serving_tiered_kv", _serving_tiered_record)
     run("serving_forked_sampling", _serving_forked_record)
     run("serving_request_telemetry", _serving_telemetry_record)
+    run("serving_seq_sharded", _serving_seq_sharded_record)
     run("ici_crossover", _ici_crossover_record, suite)
     _attach_measurement_artifacts(suite)
 
@@ -1178,6 +1236,20 @@ def _summarize_record(name, rec):
             out["flow_events"] = sum(flows.values())
         if "ledgers_recorded" in rec.get("on", {}):
             out["ledgers_recorded"] = rec["on"]["ledgers_recorded"]
+    if name == "serving_seq_sharded":
+        if "max_context_ratio" in rec:
+            out["max_context_ratio"] = rec["max_context_ratio"]
+        for arm in ("mesh1", "mesh2_seq"):
+            ctx = rec.get(arm, {}).get("max_context_tokens")
+            if ctx is not None:
+                out[f"{arm}_max_context_tokens"] = ctx
+        lat = rec.get("latency", {})
+        for arm in ("seq", "replicated"):
+            p50 = lat.get(arm, {}).get("ttft_p50_s")
+            if p50 is not None:
+                out[f"ttft_p50_{arm}_s"] = p50
+        if "merge_collectives" in rec:
+            out["merge_collectives_count"] = len(rec["merge_collectives"])
     if name == "ici_crossover":
         out["roofline_frac"] = rec.get("roofline_frac")
         for table in ("mha_1m", "gqa4_1m"):

@@ -141,6 +141,20 @@ _SPEC.loader.exec_module(bc)
     ("tokens_decoded_ledgered", None),
     ("prefix_hit_ledgered", None),
     ("overhead_budget", None),
+    # Sequence-sharded pool family (ISSUE 18): the capacity win
+    # (max context at fixed per-device pool bytes) is larger-is-better,
+    # the merge's collective count is an exact contract (the monoid is
+    # 3 collectives — any change is an algorithm change, not noise),
+    # and shard/pool geometry is workload shape that skips.
+    ("max_context_ratio", bc.LARGER_IS_BETTER),
+    ("mesh1_max_context_tokens", bc.LARGER_IS_BETTER),
+    ("mesh2_seq_max_context_tokens", bc.LARGER_IS_BETTER),
+    ("merge_collectives_count", bc.EXACT),
+    ("ttft_p50_seq_s", bc.SMALLER_IS_BETTER),
+    ("shards", None),
+    ("blocks_per_device", None),
+    ("kv_block", None),
+    ("max_new_tokens_streamed", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family
@@ -238,6 +252,34 @@ def test_compare_flags_telemetry_overhead_regression():
     assert len(regs) == 2
     assert any("tokens_per_sec_ratio" in r for r in regs)
     assert any("ttft_p50_ratio" in r for r in regs)
+
+
+def test_compare_flags_seq_shard_capacity_and_merge_cost():
+    # The capacity ratio collapsing toward 1.0 IS the regression (the
+    # sharded pool stopped buying context); the merge growing past the
+    # monoid's 3 collectives is exact; shard counts moving with the
+    # compat mesh is workload shape.
+    base = {"serving_seq_sharded": {"summary": {
+        "max_context_ratio": 2.0, "merge_collectives_count": 3,
+        "mesh2_seq": {"shards": 2},
+    }}}
+    cand = {"serving_seq_sharded": {"summary": {
+        "max_context_ratio": 1.0, "merge_collectives_count": 4,
+        "mesh2_seq": {"shards": 4},
+    }}}
+    regs, _ = bc.compare(base, cand, rtol_time=0.3, rtol_throughput=0.2,
+                         rtol_exact=0.0)
+    assert len(regs) == 2
+    assert any("max_context_ratio" in r for r in regs)
+    assert any("merge_collectives_count" in r for r in regs)
+    # ...and an unchanged monoid with a BIGGER capacity win is clean.
+    better = {"serving_seq_sharded": {"summary": {
+        "max_context_ratio": 3.9, "merge_collectives_count": 3,
+        "mesh2_seq": {"shards": 4},
+    }}}
+    regs, _ = bc.compare(base, better, rtol_time=0.3,
+                         rtol_throughput=0.2, rtol_exact=0.0)
+    assert regs == []
 
 
 def _rec(**trace):

@@ -369,6 +369,32 @@ class TestHostSync:
         ), path=HOST_POOL)
         assert len(fs) == 1 and fs[0].line == 7  # only the bare read
 
+    def test_tree_dispatch_scope(self):
+        # ISSUE 18: the sharded decode dispatch layer joins the scope —
+        # a sync in paged_tree_decode stalls every shard of every tick.
+        fs = run("host-sync", (
+            "import jax\n"
+            "def paged_tree_decode(q, k, v, tbl):\n"
+            "    return jax.device_get(q)\n"
+        ), path="tree_attention_tpu/parallel/tree.py")
+        assert len(fs) == 1
+
+    def test_models_decode_only_seq_writers_scoped(self):
+        # ISSUE 18: the *_seq pool writers run under shard_map inside
+        # jitted families — no sync allowed.  forward_step converts
+        # request metadata (host lists) with np.asarray by design and
+        # stays out of scope.
+        body = (
+            "import numpy as np\n"
+            "def _paged_pool_write_seq(pool, rows):\n"
+            "    return np.asarray(pool)\n"
+            "def forward_step(params, cache, start):\n"
+            "    return np.asarray(start)\n"
+        )
+        fs = run("host-sync", body,
+                 path="tree_attention_tpu/models/decode.py")
+        assert len(fs) == 1 and fs[0].line == 3
+
     def test_host_pool_bookkeeping_clean(self):
         # The real class's sync-free surface (alloc/enqueue/drop is pure
         # host bookkeeping) must stay clean without annotations.
@@ -414,6 +440,48 @@ class TestRecompileHygiene:
         assert len(fs) == 1 and "tq" in fs[0].message
         assert run("recompile-hygiene",
                    "tq = dc._chunk_bucket(raw_len)\n", path=DISAGG) == []
+
+    def test_shard_var_from_traced_value_flagged(self):
+        # ISSUE 18: shard geometry slices the pool — a traced shard
+        # count (lax.axis_index looks like a host int inside shard_map)
+        # makes the slice shape dynamic.
+        fs = run("recompile-hygiene", (
+            "from jax import lax\n"
+            "def merge(pool, mesh):\n"
+            "    n_shards = lax.axis_index('seq') + 1\n"
+            "    return pool.shape[0] // n_shards\n"
+        ), path="tree_attention_tpu/parallel/tree.py")
+        assert len(fs) == 1 and "n_shards" in fs[0].message \
+            and "mesh.shape" in fs[0].message
+
+    def test_shard_var_via_tainted_local_flagged(self):
+        fs = run("recompile-hygiene", (
+            "import jax.numpy as jnp\n"
+            "def merge(tbl, mesh):\n"
+            "    hi = jnp.max(tbl)\n"
+            "    n_local = hi + 1\n"
+        ), path="tree_attention_tpu/models/decode.py")
+        assert len(fs) == 1 and "n_local" in fs[0].message
+
+    def test_shard_var_from_mesh_clean(self):
+        # The real idiom: counts from mesh.shape (host-side), divisions
+        # of array .shape over them, attribute form included.
+        fs = run("recompile-hygiene", (
+            "class S:\n"
+            "    def _setup(self, mesh, pool):\n"
+            "        self._seq_shards = max(mesh.shape.get('seq', 1), 1)\n"
+            "        n_sh = mesh.shape['seq']\n"
+            "        n_local = pool.shape[0] // n_sh\n"
+        ))
+        assert fs == []
+
+    def test_shard_var_check_scoped_to_dispatch_files(self):
+        fs = run("recompile-hygiene", (
+            "from jax import lax\n"
+            "def f():\n"
+            "    n_shards = lax.axis_index('seq') + 1\n"
+        ), path="tree_attention_tpu/bench/serving.py")
+        assert fs == []
 
     def test_module_scope_jnp_flagged(self):
         fs = run("recompile-hygiene", (

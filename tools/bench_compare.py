@@ -72,6 +72,11 @@ _LARGER_SUBSTRINGS = (
     # sibling's worst-case blocks served by refcount sharing instead of
     # allocation — the CoW effectiveness ratio, larger is better.
     "fork_share_ratio",
+    # Sequence-sharded pool family (ISSUE 18): max servable context at
+    # fixed per-device pool bytes (and its mesh2/mesh1 ratio) — the
+    # capacity headline sharding exists to grow; checked before the
+    # smaller-better ratio keys so max_context_ratio lands here.
+    "max_context",
 )
 # Ratio-shaped keys where SMALLER is better (checked before the
 # larger-is-better substrings — "cost" beats "ratio").
@@ -151,6 +156,13 @@ _IGNORE_KEYS = frozenset((
     # through the standard rules.
     "ledgers_recorded", "tokens_decoded_ledgered", "prefix_hit_ledgered",
     "overhead_budget",
+    # Sequence-sharded pool record (ISSUE 18): mesh/shard geometry and
+    # pool sizing are workload shape, not performance — the guarded
+    # metrics of the family are max_context_tokens / max_context_ratio
+    # (larger-better via the max_context substring), the TTFT/TBT keys
+    # (standard rules), and merge_collectives_count (exact, pinned 3).
+    "shards", "blocks_per_device", "kv_block",
+    "max_new_tokens_streamed",
 ))
 
 

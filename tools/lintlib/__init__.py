@@ -14,12 +14,15 @@ never import jax, numpy, or anything from ``tree_attention_tpu``):
 - ``host-sync`` — the serving tick loop pays exactly ONE host sync per
   tick (Sarathi-Serve, arXiv:2403.02310: the stall-free tick IS the
   product); any ``np.asarray`` / ``.item()`` / ``device_get`` /
-  ``block_until_ready`` inside ``SlotServer.serve`` or the ops dispatch
-  paths is flagged unless annotated ``# lint: allow[host-sync] reason``.
+  ``block_until_ready`` inside ``SlotServer.serve``, the ops dispatch
+  paths, or the sharded decode dispatch layer (``parallel/tree.py``,
+  the ``*_seq`` pool writers) is flagged unless annotated
+  ``# lint: allow[host-sync] reason``.
 - ``recompile-hygiene`` — raw prompt/Tq lengths must flow through the
   pow2 bucket helpers before reaching the jitted program families;
   module-scope ``jnp`` computation and Python ``if`` on traced values
-  are flagged.
+  are flagged; shard-count shape variables in the seq-sharded dispatch
+  paths must come from ``mesh.shape``, never from traced values.
 - ``pallas-contract`` — BlockSpec index maps are pure and closure-free
   (module-level or factory-param closures only), scalar-prefetch
   operands are explicitly int32, and the tree-mask bit packers are

@@ -73,6 +73,12 @@ SLOTSERVER_DONATIONS: Dict[str, Tuple[int, ...]] = {
     # the fork's tail-block copy both donate their first operand.
     "_seed_key": (0,),
     "_fork_copy": (0,),
+    # Sequence-sharded pools (ISSUE 18) add NO rows here by design: the
+    # seq path reuses these same families — the donated pool operands
+    # are now sharded arrays (NamedSharding over the seq axis), and XLA
+    # buffer donation is per-shard-buffer, so the aliasing contract is
+    # unchanged.  _check_table_drift pins this: a new donated family on
+    # the sharded dispatch path must land in this table or fail lint.
 }
 
 #: SlotServer helpers that dispatch donating programs internally and

@@ -18,7 +18,17 @@ Scope:
 - every top-level function of ``ops/decode.py`` and ``ops/__init__.py``
   — the dispatch layer must never materialise device values (it runs
   under jit for the serving families; a host sync there is a trace
-  error at best and a per-call stall at worst).
+  error at best and a per-call stall at worst);
+- every top-level function of ``parallel/tree.py`` (ISSUE 18) — the
+  sharded decode dispatch layer: ``paged_tree_decode`` and the ring/tree
+  dispatchers run once per decode tick to build collective programs, so
+  a sync here stalls every shard of every tick, and nothing in the file
+  owns host-resident state that would need one;
+- the ``*_seq`` pool-write dispatchers of ``models/decode.py``
+  (ISSUE 18) — the seq-sharded scatter path runs under shard_map inside
+  the engine's jitted families.  ``forward_step`` proper stays OUT of
+  scope: it converts *request* metadata (host lists of starts/lengths)
+  with ``np.asarray`` by design.
 
 Rules:
 
@@ -91,9 +101,21 @@ def _scoped_functions(src: Source) -> List[ast.FunctionDef]:
             for fn in cls.body if isinstance(fn, ast.FunctionDef)
         ]
     if src.path in ("tree_attention_tpu/ops/decode.py",
-                    "tree_attention_tpu/ops/__init__.py"):
+                    "tree_attention_tpu/ops/__init__.py",
+                    "tree_attention_tpu/parallel/tree.py"):
+        # parallel/tree.py joins the dispatch scope with ISSUE 18: the
+        # paged decode merge (paged_tree_decode) is built here every
+        # tick, and a sync in any dispatcher stalls all shards at once.
         return [fn for fn in src.tree.body
                 if isinstance(fn, ast.FunctionDef)]
+    if src.path == "tree_attention_tpu/models/decode.py":
+        # Only the seq-sharded pool-write dispatchers (ISSUE 18): the
+        # *_seq scatter runs under shard_map inside jitted families.
+        # forward_step itself converts request metadata (host lists)
+        # with np.asarray by design and stays out of scope.
+        return [fn for fn in src.tree.body
+                if isinstance(fn, ast.FunctionDef)
+                and fn.name.endswith("_seq")]
     return []
 
 

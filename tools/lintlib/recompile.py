@@ -25,6 +25,16 @@ unbounded compile storm that only shows up as p99 latency.  Checks:
 - **unhashable static args**: a list/dict/set display passed to a
   ``static_argnames`` parameter of a jitted family at a call site dies
   with ``unhashable type`` on the first call that misses the cache.
+- **shard-count shape variables** (the sharded dispatch paths:
+  ``parallel/tree.py``, ``models/decode.py``, ``serving/engine.py``,
+  ``serving/disagg.py`` — ISSUE 18): an assignment to a shard-geometry
+  name (``n_shards``/``n_local``/``n_sh``/``seq_shards``/…) must not
+  derive from a traced value (a ``jnp.*``/``lax.*`` result, e.g.
+  ``lax.axis_index`` arithmetic).  Shard geometry slices the pool —
+  ``pool.shape[0] // n_shards`` — so a traced count makes the slice
+  shape dynamic: ``TracerIntegerConversionError`` at best, one compiled
+  program per observed value at worst.  It must come from ``mesh.shape``
+  (host-side, known at trace time) or quantities derived from it.
 """
 
 from __future__ import annotations
@@ -85,6 +95,73 @@ def _check_shape_vars(src: Source, findings: List[Finding]) -> None:
                          f"non-bucketed expression — raw lengths must "
                          f"flow through _bucket/_chunk_bucket/"
                          f"_spec_bucket before reaching a jitted family")
+
+
+# -- shard-count shape variables ------------------------------------------
+
+#: Files hosting the seq-sharded dispatch paths (ISSUE 18).
+_SHARD_FILES = (
+    "tree_attention_tpu/parallel/tree.py",
+    "tree_attention_tpu/models/decode.py",
+    "tree_attention_tpu/serving/engine.py",
+    "tree_attention_tpu/serving/disagg.py",
+)
+#: Names that carry shard geometry into pool-slicing shapes.  Matched on
+#: both plain locals (``n_shards = …``) and attributes
+#: (``self._seq_shards = …``).
+_SHARD_NAMES = {
+    "n_shards", "n_local", "n_sh", "seq_shards", "_seq_shards",
+    "shard_blocks",
+}
+_TRACED_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+
+def _check_shard_vars(src: Source, findings: List[Finding]) -> None:
+    """Shard-count shape vars must come from mesh, not traced values.
+
+    Flow-insensitive over the file: first collect every local bound from
+    a ``jnp.*``/``lax.*`` call (a traced value — ``lax.axis_index`` is
+    the seductive one: it *looks* like a host integer inside shard_map),
+    then flag any shard-geometry assignment whose right-hand side calls
+    into traced computation or reads one of those locals."""
+    if src.path not in _SHARD_FILES:
+        return
+    traced: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = dotted(node.value.func) or ""
+            if d.startswith(_TRACED_PREFIXES):
+                for t in node.targets:
+                    for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                        if isinstance(el, ast.Name):
+                            traced.add(el.id)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        name = None
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in _SHARD_NAMES:
+                name = t.id
+            elif isinstance(t, ast.Attribute) and t.attr in _SHARD_NAMES:
+                name = t.attr
+        if name is None:
+            continue
+        bad = None
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func) or ""
+                if d.startswith(_TRACED_PREFIXES):
+                    bad = f"{d}(...)"
+                    break
+            elif isinstance(sub, ast.Name) and sub.id in traced:
+                bad = f"'{sub.id}'"
+                break
+        if bad is not None:
+            emit(findings, src, RULE, node,
+                 f"shard-count shape variable '{name}' derives from "
+                 f"traced value {bad} — shard geometry slices the pool, "
+                 f"so it must come from mesh.shape (host-side), never "
+                 f"from device computation")
 
 
 # -- module-scope jnp ------------------------------------------------------
@@ -229,6 +306,7 @@ def check(src: Source) -> List[Finding]:
         return []
     findings: List[Finding] = []
     _check_shape_vars(src, findings)
+    _check_shard_vars(src, findings)
     _check_module_jnp(src, findings)
     _check_traced_ifs(src, findings)
     _check_static_args(src, findings)

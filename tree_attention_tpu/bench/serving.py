@@ -2816,3 +2816,190 @@ def bench_serving_request_telemetry(
             "ttft_p50_ratio": ttft_ratio,
         },
     }
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: sequence-sharded paged pool — capacity at fixed per-device bytes
+# ---------------------------------------------------------------------------
+
+
+def bench_serving_seq_sharded(
+    *,
+    slots: int = 1,
+    kv_block: int = 8,
+    blocks_per_shard: int = 8,
+    max_new_tokens: int = 4,
+    lat_prompt_len: int = 24,
+    lat_requests: int = 3,
+    prefill_chunk: int = 8,
+    cfg: Optional[TransformerConfig] = None,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """The sequence-sharded serving record (ISSUE 18): max servable
+    context at EQUAL per-device pool bytes, mesh=1 vs mesh=2, plus
+    TTFT/TBT on a common trace — parity-gated, with the decode merge's
+    collective count asserted through the accounting counters.
+
+    **Capacity** — each arm gets ``blocks_per_shard`` pool blocks PER
+    DEVICE: the mesh=1 arm a ``blocks_per_shard``-block replicated pool,
+    the mesh=2 arm a ``2 * blocks_per_shard``-block pool range-
+    partitioned by ``kv_shard="seq"``. Both boundaries are MEASURED, not
+    computed: a single request sized to exactly fill the pool must
+    stream ``max_new_tokens`` tokens, and one block more must be
+    rejected by admission validation ("can never fit"). The headline
+    ``max_context_ratio`` is the sharded arm's measured ceiling over the
+    single-device arm's — 2.0 at W=2 by construction of the sharding,
+    and the record proves the construction.
+
+    **Latency + parity** — a small common trace through the mesh=2
+    sharded arm vs a mesh=2 REPLICATED oracle: streams must be
+    token-identical before TTFT/TBT p50 are reported (CPU proxy:
+    absolute seconds do not transfer; the structure — capacity scaling
+    with W at ~flat tick latency — is the claim).
+
+    **Merge cost** — the sharded arm's decode dispatch must account
+    EXACTLY three collectives (``pmax`` on the running max, ``psum`` on
+    the weighted numerator, ``psum`` on the denominator — the tree
+    monoid, arXiv:2408.04093); any fourth label in
+    ``collective_payload_bytes_total{algorithm="paged_tree_decode"}``
+    fails the record.
+    """
+    from tree_attention_tpu.parallel.accounting import PAYLOAD_BYTES
+    from tree_attention_tpu.parallel.mesh import cpu_mesh
+
+    cache_len = 2 * blocks_per_shard * kv_block
+    cfg = cfg or serving_model_config(max_seq_len=cache_len)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    mesh2 = cpu_mesh(2)
+
+    def make_server(blocks: int, *, mesh=None, kv_shard="replicated",
+                    quantize=False):
+        return SlotServer(
+            params, cfg, slots=slots, cache_len=cache_len, mesh=mesh,
+            prefill_chunk=prefill_chunk, quantize=quantize,
+            kv_layout="paged", kv_block=kv_block, kv_blocks=blocks,
+            kv_shard=kv_shard,
+        )
+
+    def probe_max_context(blocks: int, **kw) -> Dict[str, Any]:
+        """Measure the capacity boundary: a pool-filling request must
+        serve; a one-block-longer one must be rejected up front."""
+        fits = blocks * kv_block
+        rng = np.random.default_rng(seed + 17)
+
+        def one(total: int):
+            prompt = rng.integers(
+                0, cfg.vocab_size, size=total - max_new_tokens
+            ).astype(np.int32)
+            server = make_server(blocks, **kw)
+            return server.serve([Request(
+                uid=0, prompt=prompt, max_new_tokens=max_new_tokens,
+                arrival_tick=0,
+            )], max_ticks=600)
+
+        rep = one(fits)
+        if len(rep.results[0].tokens) != max_new_tokens:
+            raise AssertionError(
+                f"seq-sharded bench: the pool-filling request "
+                f"({fits} tokens over {blocks} blocks) did not stream"
+            )
+        try:
+            one(fits + kv_block)
+        except ValueError:
+            pass  # the measured boundary: one more block can never fit
+        else:
+            raise AssertionError(
+                f"seq-sharded bench: a {fits + kv_block}-token request "
+                f"was admitted over a {blocks}-block pool"
+            )
+        return {"pool_blocks": blocks,
+                "max_context_tokens": fits,
+                "max_new_tokens_streamed": max_new_tokens}
+
+    with obs.span("bench_serving_seq_sharded:capacity", cat="bench"):
+        mesh1 = probe_max_context(blocks_per_shard)
+        mesh2_seq = probe_max_context(
+            2 * blocks_per_shard, mesh=mesh2, kv_shard="seq")
+        mesh2_seq["shards"] = 2
+
+    # --- latency + parity on a common trace, mesh=2 sharded vs oracle ---
+    trace_kw = dict(
+        n_requests=lat_requests, prompt_len=lat_prompt_len,
+        prompt_jitter=0, max_new_tokens=max_new_tokens,
+        arrival_every=1, vocab_size=cfg.vocab_size,
+    )
+    was_enabled = obs.REGISTRY.enabled
+    obs.REGISTRY.enable()
+    try:
+        with obs.span("bench_serving_seq_sharded:trace", cat="bench"):
+            lat = {}
+            for arm, kv_shard in (("seq", "seq"), ("replicated",
+                                                   "replicated")):
+                server = make_server(
+                    2 * blocks_per_shard if kv_shard == "seq"
+                    else blocks_per_shard,
+                    mesh=mesh2, kv_shard=kv_shard,
+                )
+                server.serve(synthetic_trace(**trace_kw, seed=seed + 1))
+                rep = server.serve(
+                    synthetic_trace(**trace_kw, seed=seed + 2))
+                leak = server.leak_report()
+                if any(leak.values()):
+                    raise AssertionError(
+                        f"seq-sharded bench: {arm} arm leaked: {leak}")
+                d = rep.as_dict()
+                lat[arm] = {
+                    "ttft_p50_s": d["ttft_p50_s"],
+                    "tbt_p50_s": d["tbt_p50_s"],
+                    "tbt_p95_s": d["tbt_p95_s"],
+                    "tokens": {r.uid: r.tokens for r in rep.results},
+                }
+    finally:
+        if not was_enabled:
+            obs.REGISTRY.disable()
+    if lat["seq"]["tokens"] != lat["replicated"]["tokens"]:
+        raise AssertionError(
+            "seq-sharded bench: token parity broke between the sharded "
+            "arm and the replicated oracle at mesh=2"
+        )
+    for a in lat.values():
+        del a["tokens"]
+
+    # The merge monoid's wire cost: exactly one MAX and two SUMs.
+    colls = sorted(
+        key[1] for key in PAYLOAD_BYTES._children
+        if key[0] == "paged_tree_decode"
+    )
+    if colls != ["pmax", "psum_den", "psum_num"]:
+        raise AssertionError(
+            f"seq-sharded bench: decode merge accounted collectives "
+            f"{colls}, expected exactly [pmax, psum_den, psum_num]"
+        )
+
+    ratio = round(
+        mesh2_seq["max_context_tokens"] / mesh1["max_context_tokens"], 2
+    )
+    log.info(
+        "seq-sharded serving: max context %d tokens at mesh=2 vs %d at "
+        "mesh=1 (%.2fx at equal per-device pool bytes); TTFT p50 %.4fs "
+        "sharded vs %.4fs replicated; merge = 3 collectives",
+        mesh2_seq["max_context_tokens"], mesh1["max_context_tokens"],
+        ratio, lat["seq"]["ttft_p50_s"], lat["replicated"]["ttft_p50_s"],
+    )
+    return {
+        "workload": {
+            "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                      "heads": cfg.n_heads, "kv_heads": cfg.n_kv_heads,
+                      "vocab": cfg.vocab_size},
+            "slots": slots, "cache_len": cache_len,
+            "kv_block": kv_block,
+            "blocks_per_device": blocks_per_shard,
+            "trace": trace_kw,
+        },
+        "mesh1": mesh1,
+        "mesh2_seq": mesh2_seq,
+        "max_context_ratio": ratio,
+        "latency": lat,
+        "merge_collectives": colls,
+        "parity": "token-identical (sharded vs replicated oracle, mesh=2)",
+    }

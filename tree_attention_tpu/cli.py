@@ -551,6 +551,12 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
                 "(demotion is what radix eviction becomes; with no "
                 "radix tree nothing ever demotes)"
             )
+    if cfg.kv_shard == "seq" and cfg.kv_layout != "paged":
+        raise SystemExit(
+            "--kv-shard seq requires --kv-layout paged (sequence "
+            "sharding partitions the block pool; the contiguous layout "
+            "has none)"
+        )
     if cfg.kv_block is not None and (cfg.kv_block < 1
                                      or cfg.kv_block & (cfg.kv_block - 1)):
         raise SystemExit("--kv-block must be a power of two >= 1")
@@ -632,6 +638,7 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
         kv_layout=cfg.kv_layout,
         kv_block=cfg.kv_block,
         kv_blocks=kv_blocks,
+        kv_shard=cfg.kv_shard,
         host_blocks=host_blocks,
         speculate=cfg.speculate,
         draft_k=cfg.draft_k,

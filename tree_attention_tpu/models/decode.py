@@ -81,6 +81,7 @@ _CACHE_QUANTIZE = obs.counter(
     "whole-cache int8 quantizations (quantize-after-prefill)",
 )
 from tree_attention_tpu.ops.decode import flash_decode
+from tree_attention_tpu.parallel.compat import shard_map
 from tree_attention_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_MODEL,
@@ -467,6 +468,8 @@ def init_paged_cache(
     block: int = 64,
     mesh: Optional[Mesh] = None,
     quantize: bool = False,
+    kv_shard: str = "replicated",
+    seq_axis: str = AXIS_SEQ,
 ) -> Union[PagedKVCache, PagedQuantKVCache]:
     """Allocate a paged cache: one ``blocks``-block pool + empty tables.
 
@@ -474,9 +477,23 @@ def init_paged_cache(
     number of blocks — the table width); ``blocks`` is the POOL capacity
     shared by every slot, which may be far less than
     ``batch_size × max_len`` tokens (the point of paging). Under a mesh
-    the pool is **replicated**: table entries place blocks at arbitrary
-    token offsets, so no static sharding of the block axis can stay
-    aligned with a sequence shard (same argument as the prefix pool).
+    ``kv_shard`` picks the pool placement:
+
+    - ``"replicated"`` (compat default): every device holds the whole
+      pool — table entries place blocks at arbitrary token offsets, so no
+      static sharding of the TOKEN axis can stay aligned with a sequence
+      shard, and capacity is capped by one device's memory.
+    - ``"seq"`` (ISSUE 18): shard the BLOCK axis instead — blocks are the
+      unit of placement, not token ranges, so the arbitrary-offset
+      argument above does not apply to them. Shard ``s`` of ``W`` owns
+      global block ids ``[s·N/W, (s+1)·N/W)`` (``blocks`` must divide by
+      the ``seq_axis`` size; callers round up), tables stay replicated
+      with GLOBAL ids, and pool bytes per device drop to ``1/W`` — max
+      servable context finally scales WITH the mesh. Int8 per-block
+      scales shard with their pool slice. Attention runs the
+      shard_map'd tree-monoid merge
+      (:func:`~tree_attention_tpu.parallel.tree.paged_tree_decode`).
+
     ``quantize`` allocates int8 pools with per-slot unit scales — the
     same empty-cache fallback :func:`quantize_cache` produces, so a
     paged and a contiguous int8 server start bit-identical.
@@ -485,16 +502,34 @@ def init_paged_cache(
         raise ValueError(f"kv block must be a power of two, got {block}")
     if blocks < 1:
         raise ValueError(f"paged pool needs >= 1 block, got {blocks}")
+    if kv_shard not in ("replicated", "seq"):
+        raise ValueError(
+            f"kv_shard must be 'replicated' or 'seq', got {kv_shard!r}"
+        )
+    seq_sharded = kv_shard == "seq" and mesh is not None
+    if seq_sharded:
+        n_sh = max(mesh.shape.get(seq_axis, 1), 1)
+        if blocks % n_sh:
+            raise ValueError(
+                f"kv_shard='seq': pool of {blocks} blocks must divide "
+                f"over {n_sh} '{seq_axis}' shards — round the pool up"
+            )
     nb = -(-max_len // block)
     shape = (cfg.n_layers, blocks, cfg.n_kv_heads, block, cfg.d_head)
     dtype = jnp.int8 if quantize else cfg.dtype
+    sscale = None
     if mesh is not None:
-        sharding = NamedSharding(mesh, P())  # replicated (see above)
+        pool_p = P(None, seq_axis) if seq_sharded else P()
+        sharding = NamedSharding(mesh, pool_p)
         zeros = jax.jit(
             lambda: jnp.zeros(shape, dtype), out_shardings=sharding
         )
         k = zeros()
         v = zeros()
+        if quantize:
+            sscale = NamedSharding(
+                mesh, P(None, seq_axis) if seq_sharded else P()
+            )
     else:
         k = jnp.zeros(shape, dtype)
         v = jnp.zeros(shape, dtype)
@@ -505,6 +540,12 @@ def init_paged_cache(
         _CACHE_ALLOCS.labels(sharded=str(mesh is not None).lower()).inc()
     if quantize:
         sshape = (cfg.n_layers, blocks, cfg.n_kv_heads)
+        ones = (
+            jax.jit(lambda: jnp.ones(sshape, jnp.float32),
+                    out_shardings=sscale)
+            if sscale is not None
+            else lambda: jnp.ones(sshape, jnp.float32)
+        )
         return PagedQuantKVCache(
             k=k, v=v,
             # Per-BLOCK scale scalars (see the class docstring). Two
@@ -512,8 +553,8 @@ def init_paged_cache(
             # alias k_scale and v_scale. Unit scales = the empty-cache
             # fallback, same as quantize_symmetric_int8's zero-channel
             # contract.
-            k_scale=jnp.ones(sshape, jnp.float32),
-            v_scale=jnp.ones(sshape, jnp.float32),
+            k_scale=ones(),
+            v_scale=ones(),
             table=table, length=length,
         )
     return PagedKVCache(k=k, v=v, table=table, length=length)
@@ -556,6 +597,46 @@ def _paged_pool_write(
     return pool.at[pb.reshape(-1), :, (pos % block).reshape(-1), :].set(
         flat.astype(pool.dtype), mode="drop"
     )
+
+
+def _paged_pool_write_seq(
+    pool: jax.Array,
+    rows: jax.Array,
+    table: jax.Array,
+    start: jax.Array,
+    n: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str,
+) -> jax.Array:
+    """:func:`_paged_pool_write` over a sequence-SHARDED pool (ISSUE 18).
+
+    ``pool`` is one layer's ``(N, Hkv, block, D)`` slice sharded on the
+    block axis over ``seq_axis``; the (replicated) ``table`` carries
+    GLOBAL block ids. Under ``shard_map`` each shard rebases the table to
+    its own id range ``[s·N/W, (s+1)·N/W)`` and points every entry it
+    does NOT own at its local ``N/W`` sentinel — which is exactly
+    :func:`_paged_pool_write`'s OOB→drop index, so the local scatter
+    writes precisely the rows whose blocks live here and drops the rest.
+    No collectives: a block is owned by exactly one shard, so the union
+    of the local writes IS the replicated write, bit for bit.
+    """
+    n_sh = mesh.shape[seq_axis]
+    n_local = pool.shape[0] // n_sh
+
+    def body(pool_l, rows_l, table_l, start_l, n_l):
+        s = lax.axis_index(seq_axis)
+        loc = table_l - s * n_local
+        loc = jnp.where((loc >= 0) & (loc < n_local), loc, n_local)
+        return _paged_pool_write(pool_l, rows_l, loc, start_l, n_l)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(seq_axis), P(), P(), P(), P()),
+        out_specs=P(seq_axis),
+        check_vma=False,
+    )(pool, rows, table, start, n)
 
 
 def paged_insert_slot(
@@ -674,8 +755,18 @@ def forward_step(
     n_tokens: Optional[jax.Array] = None,
     positions: Optional[jax.Array] = None,
     tree_mask: Optional[jax.Array] = None,
+    kv_shard: str = "replicated",
 ) -> Tuple[jax.Array, Union[KVCache, QuantKVCache]]:
     """Run ``Tq`` new tokens through the model against the cache.
+
+    ``kv_shard="seq"`` (paged caches under a >1-way ``seq_axis`` mesh
+    only — see :func:`init_paged_cache`) declares the pool
+    block-sharded: per-layer KV writes and attention both run under
+    ``shard_map`` (:func:`_paged_pool_write_seq`,
+    :func:`~tree_attention_tpu.parallel.tree.paged_tree_decode` — each
+    shard computes flash partials over only its local blocks, merged by
+    the 3-collective tree monoid). ``tree_mask`` is not supported there
+    (chain speculation is; the engine gates draft trees off).
 
     Args:
       tokens: ``(B, Tq)`` token ids; row ``i`` occupies global positions
@@ -793,6 +884,16 @@ def forward_step(
     # paged kernels stream blocks in place and this path never runs.
     hoist_view = False
     paged_quant = paged and quant
+    seq_sharded = False
+    if kv_shard not in ("replicated", "seq"):
+        raise ValueError(
+            f"kv_shard must be 'replicated' or 'seq', got {kv_shard!r}"
+        )
+    if kv_shard == "seq" and not paged:
+        raise ValueError(
+            "kv_shard='seq' shards the paged block pool; contiguous "
+            "caches shard the token axis via the mesh instead"
+        )
     if paged:
         from tree_attention_tpu.ops import _on_tpu, _pallas_available
         from tree_attention_tpu.ops.decode import _AUTO_PALLAS
@@ -807,7 +908,20 @@ def forward_step(
             max(mesh.shape.get(axes["seq"] or "", 1), 1)
             if mesh is not None else 1
         )
-        if paged_quant:
+        seq_sharded = kv_shard == "seq" and seq_shards > 1
+        if seq_sharded and tree_mask is not None:
+            raise ValueError(
+                "tree_mask is not supported under kv_shard='seq' "
+                "(paged_tree_decode has no window-mask plumbing); use "
+                "chain drafts or the replicated pool"
+            )
+        if seq_sharded:
+            # The hoisted contiguous view is a REPLICATED materialisation
+            # of the pool — the exact thing kv_shard='seq' exists to
+            # avoid. Attention stays on the block-table path, whose
+            # sharded dispatch gathers per shard inside shard_map.
+            hoist_view = False
+        elif paged_quant:
             # Per-block scales (ISSUE 13): on TPU the q8 kernels read
             # them as a block-indexed lane-broadcast operand; everywhere
             # else the whole step runs on a DEQUANTIZED logical view
@@ -933,12 +1047,22 @@ def forward_step(
                 jnp.full((B,), Tq, jnp.int32) if n_tokens is None
                 else n_tokens
             )
-            k_cache = _paged_pool_write(
-                k_cache, k_new, cache.table, start, n_valid
-            )
-            v_cache = _paged_pool_write(
-                v_cache, v_new, cache.table, start, n_valid
-            )
+            if seq_sharded:
+                k_cache = _paged_pool_write_seq(
+                    k_cache, k_new, cache.table, start, n_valid,
+                    mesh=mesh, seq_axis=axes["seq"],
+                )
+                v_cache = _paged_pool_write_seq(
+                    v_cache, v_new, cache.table, start, n_valid,
+                    mesh=mesh, seq_axis=axes["seq"],
+                )
+            else:
+                k_cache = _paged_pool_write(
+                    k_cache, k_new, cache.table, start, n_valid
+                )
+                v_cache = _paged_pool_write(
+                    v_cache, v_new, cache.table, start, n_valid
+                )
             if hoist_view:
                 # Mirror the new rows into the hoisted logical view (the
                 # pre-scan gather predates this layer's write) — a cheap
@@ -988,6 +1112,8 @@ def forward_step(
         )
         if paged and not hoist_view:
             attn_kw["block_table"] = cache.table
+            if seq_sharded:
+                attn_kw["kv_shard"] = "seq"
         ak, av = (k_view, v_view) if hoist_view else (k_cache, v_cache)
         if quant and not (paged and hoist_view):
             out, _ = decode_attention(
@@ -1392,6 +1518,7 @@ def decode_attention(
     quant_kernel: str = "q8q",
     block_table: Optional[jax.Array] = None,
     tree_mask: Optional[jax.Array] = None,
+    kv_shard: str = "replicated",
 ) -> Tuple[jax.Array, jax.Array]:
     """Op-level decode entry: split-KV on one device, tree merge on a mesh.
 
@@ -1416,15 +1543,37 @@ def decode_attention(
     quant = k_scale is not None
     if quant and v_scale is None or (not quant and v_scale is not None):
         raise ValueError("pass both k_scale and v_scale, or neither")
+    ax = prune_axes(
+        mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
+    )
     if block_table is not None:
         # Paged KV: k/v are (N, Hkv, block, D) pools and the table maps
-        # each slot's logical blocks to pool rows. The pool is REPLICATED
-        # under a mesh (blocks land at arbitrary token offsets, so no
-        # static sharding of the block axis aligns with a seq shard), so
-        # the tree merge never applies — the flash/Pallas paths serve
-        # every topology.
+        # each slot's logical blocks to pool rows. With the default
+        # REPLICATED pool the flash/Pallas paths serve every topology
+        # (blocks land at arbitrary token offsets, so no static sharding
+        # of the TOKEN axis aligns with a seq shard). kv_shard="seq"
+        # declares the pool BLOCK-sharded instead (ISSUE 18) and routes
+        # to the shard_map'd 3-collective tree merge.
         if q_position is None:
             raise ValueError("paged decode needs an explicit q_position")
+        if (
+            kv_shard == "seq"
+            and mesh is not None
+            and mesh.shape.get(ax["seq"] or "", 1) > 1
+        ):
+            if tree_mask is not None:
+                raise ValueError(
+                    "tree_mask is not supported under kv_shard='seq'; "
+                    "use chain drafts or the replicated pool"
+                )
+            from tree_attention_tpu.parallel.tree import paged_tree_decode
+
+            return paged_tree_decode(
+                q, k, v, block_table,
+                mesh=mesh, seq_axis=ax["seq"], data_axis=ax["data"],
+                head_axis=ax["model"], q_position=q_position,
+                k_scale=k_scale, v_scale=v_scale,
+            )
         if quant:
             from tree_attention_tpu.ops.pallas_decode import (
                 resolve_q8_kernel,
@@ -1443,9 +1592,6 @@ def decode_attention(
         )
     if q_position is None:
         q_position = k.shape[2] - q.shape[2]
-    ax = prune_axes(
-        mesh, {"data": data_axis, "seq": seq_axis, "model": model_axis}
-    )
     if mesh is not None and mesh.shape.get(ax["seq"] or "", 1) > 1:
         if tree_mask is not None:
             # The tree merge has no window-mask plumbing; the serving

@@ -323,3 +323,138 @@ def flash_decode(
     _account_dispatch("chunked_vmap", Tk)
     outs, lses = jax.vmap(one_chunk)(kb, vb, offsets)
     return merge_partials(outs, lses)
+
+
+def paged_local_partial(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    local_table: jax.Array,
+    *,
+    q_position,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One shard's flash partial over its LOCAL slice of a sequence-sharded
+    paged pool (ISSUE 18): the per-shard half of the tree-attention decode
+    monoid, run inside ``shard_map`` by
+    :func:`~tree_attention_tpu.parallel.tree.paged_tree_decode`.
+
+    Args:
+      q: ``(B, Hq, Tq, D)`` — replicated queries (every shard sees all of
+        them; the merge weighs the partials).
+      k, v: ``(Nl, Hkv, block, D)`` — this shard's pool slice (``Nl = N/W``
+        blocks of the global pool).
+      local_table: ``(B, NB)`` int32 — the slot tables rebased to LOCAL
+        block ids: entries in ``[0, Nl)`` name a local block, **negative
+        entries mean the logical block lives on another shard** and its
+        keys must not contribute here (the per-slot cull against the
+        shard's local coverage). The signed convention is shared with the
+        Pallas local-partial kernel
+        (:func:`~tree_attention_tpu.ops.pallas_decode
+        .attention_pallas_decode` with ``local_blocks=True``).
+      q_position: per-slot ``(B,)`` global position of each slot's first
+        query row (the ragged serving shape); the causal rule is the usual
+        ``key_pos <= q_position[b] + i`` in LOGICAL positions — a logical
+        block's keys sit at the same global positions on every shard, so
+        the per-shard partials merge into exactly the replicated result.
+      k_scale, v_scale: optional ``(Nl, Hkv)`` per-block int8 scales (the
+        slice sharded WITH the pool slice); when given, ``k``/``v`` are
+        int8 and each local block's keys/values are dequantized under its
+        own scale before the partial — the same quantize-then-dequantize
+        rows the replicated off-kernel path attends over.
+
+    Returns:
+      ``(out, lse)`` — ``(B, Hq, Tq, D)`` in q's dtype and ``(B, Hq, Tq)``
+      float32, normalized WITHIN the shard; rows with no locally visible
+      key emit the safe-softmax identity ``(0, -inf)`` (see
+      :func:`~tree_attention_tpu.ops.reference.finalize`), so empty or
+      fully-future shards drop out of the merge exactly.
+    """
+    from tree_attention_tpu.ops import _on_tpu, _pallas_available
+    from tree_attention_tpu.ops.reference import (
+        NEG_INF,
+        _default_scale,
+        finalize,
+        matmul_precision,
+    )
+
+    B, Hq, Tq, D = q.shape
+    Nl, Hkv, blk, _ = k.shape
+    NB = local_table.shape[1]
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if getattr(q_position, "ndim", 0) != 1:
+        raise ValueError(
+            "paged_local_partial needs a per-slot (B,) q_position"
+        )
+
+    if not quant and _AUTO_PALLAS and _on_tpu(q) and _pallas_available():
+        from tree_attention_tpu.ops.pallas_decode import (
+            attention_pallas_decode,
+        )
+
+        _account_dispatch("paged_local_partial", NB * blk)
+        return attention_pallas_decode(
+            q, k, v, causal=True, scale=scale,
+            q_offset=q_position, kv_offset=0,
+            block_table=local_table, local_blocks=True,
+        )
+
+    # Reference path (CPU / interpret / int8-dequant): gather the local
+    # logical view — unowned entries clamp to block 0 and are masked out
+    # below, mirroring gather_paged_kv's clamp-then-mask contract.
+    owned = local_table >= 0
+    idx = jnp.clip(local_table, 0, Nl - 1)
+
+    def view(pool: jax.Array, scl: Optional[jax.Array]) -> jax.Array:
+        rows = jnp.moveaxis(pool[idx], 1, 2)  # (B, Hkv, NB, blk, D)
+        if scl is not None:
+            s = jnp.swapaxes(scl[idx], 1, 2)  # (B, Hkv, NB)
+            rows = (
+                rows.astype(jnp.float32) * s[..., None, None]
+            ).astype(q.dtype)
+        return rows.reshape(B, Hkv, NB * blk, D)
+
+    kb = view(k, k_scale)
+    vb = view(v, v_scale)
+
+    G = Hq // Hkv
+    s = _default_scale(D, scale)
+    qg = q.reshape(B, Hkv, G, Tq, D)
+    logits = jnp.einsum(
+        "bhgqd,bhkd->bhgqk", qg, kb.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+        precision=matmul_precision(qg.dtype, kb.dtype),
+    ) * s
+    key_pos = jnp.arange(NB * blk, dtype=jnp.int32)
+    q_pos = (
+        jnp.asarray(q_position, jnp.int32)[:, None]
+        + jnp.arange(Tq, dtype=jnp.int32)[None, :]
+    )  # (B, Tq)
+    visible = (
+        jnp.repeat(owned, blk, axis=1)[:, None, :]          # local coverage
+        & (key_pos[None, None, :] <= q_pos[..., None])      # causal
+    )  # (B, Tq, K)
+    logits = jnp.where(visible[:, None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bhgqk,bhkd->bhgqd", p, vb.astype(jnp.float32),
+        precision=matmul_precision(jnp.float32),
+    )
+    _account_dispatch("paged_local_partial", NB * blk)
+    return finalize(
+        acc.reshape(B, Hq, Tq, D),
+        m.reshape(B, Hq, Tq),
+        l.reshape(B, Hq, Tq),
+        q.dtype,
+    )

@@ -361,6 +361,7 @@ def _flash_decode_paged_kernel(
     n_kv_heads: int,
     tree: bool = False,
     block_scales: bool = False,
+    local_blocks: bool = False,
 ):
     """Block-table variant of :func:`_flash_decode_kernel`: the split-KV
     grid dimension walks each slot's LOGICAL blocks and the BlockSpec
@@ -378,8 +379,18 @@ def _flash_decode_paged_kernel(
     dequantization SCALARS — K's multiplies the score tile after the
     matmul (a scalar commutes out of the dot product, so no per-element
     K dequant rides the KV stream), V's folds into ``p`` (see
-    :func:`_decode_softmax_fold`)."""
-    del tbl_ref  # consumed by the index maps
+    :func:`_decode_softmax_fold`).
+
+    ``local_blocks`` (ISSUE 18, the sequence-sharded pool): the table is
+    SIGNED — a negative entry marks a logical block another shard owns.
+    The index map clamps the DMA to pool row 0 (some valid row must
+    stream), and the body's liveness gate skips folding it, so the
+    online-softmax state accumulates exactly this shard's partial; rows
+    whose every block is remote finalize to the ``(0, -inf)`` merge
+    identity that :func:`tree_attention_tpu.parallel.tree._weigh`
+    absorbs."""
+    if not local_blocks:
+        del tbl_ref  # consumed by the index maps
     ks_ref = vs_ref = None
     if tree and block_scales:
         q_ref, tb_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref, \
@@ -414,6 +425,8 @@ def _flash_decode_paged_kernel(
     live = si * bk < tk
     if causal:
         live &= (kv_offset + si * bk) <= (q_offset + tq - 1)
+    if local_blocks:
+        live &= tbl_ref[b, si] >= 0
 
     @pl.when(live)
     def _compute():
@@ -541,14 +554,22 @@ def _paged_q_map(bh, qi, si, offs_ref, tbl_ref):
     return (bh, qi, 0)
 
 
-def _paged_kv_map(n_kv_heads: int):
+def _paged_kv_map(n_kv_heads: int, local: bool = False):
     """K/V index map: grid step ``si`` loads pool block
     ``table[b, si]`` of head ``bh % Hkv`` — the block-table indirection
-    happens HERE, in the prefetch-driven DMA schedule, not in the body."""
+    happens HERE, in the prefetch-driven DMA schedule, not in the body.
+
+    ``local`` (ISSUE 18): the table is signed; a negative entry marks a
+    block this shard does not own. The DMA engine still needs SOME valid
+    pool row, so the map clamps to 0 — the body's ``tbl_ref[b, si] >= 0``
+    gate drops the streamed tile before it touches the softmax state."""
 
     def index_map(bh, qi, si, offs_ref, tbl_ref):
         del qi, offs_ref
-        return (tbl_ref[bh // n_kv_heads, si], bh % n_kv_heads, 0, 0)
+        t = tbl_ref[bh // n_kv_heads, si]
+        if local:
+            t = jnp.maximum(t, 0)
+        return (t, bh % n_kv_heads, 0, 0)
 
     return index_map
 
@@ -1062,7 +1083,9 @@ def attention_pallas_decode_q8q(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_size", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_size", "interpret", "local_blocks",
+    ),
 )
 def attention_pallas_decode(
     q: jax.Array,
@@ -1077,6 +1100,7 @@ def attention_pallas_decode(
     interpret: Optional[bool] = None,
     block_table: Optional[jax.Array] = None,
     tree_mask: Optional[jax.Array] = None,
+    local_blocks: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Split-KV flash decode. Same ``(out, lse)`` contract as the other impls.
 
@@ -1108,8 +1132,17 @@ def attention_pallas_decode(
     rule (see :func:`_decode_visibility_mask`): it is packed into int32
     per-row bitmasks that ride a lane-broadcast VMEM operand, exactly
     like the q8q per-row Q scales.
+
+    ``local_blocks`` (ISSUE 18, requires ``block_table``): the table is a
+    SIGNED per-shard local view — negative entries mark logical blocks
+    owned by other shards of a sequence-sharded pool. Those grid steps
+    clamp their DMA to row 0 and the body culls them, so the returned
+    ``(out, lse)`` is this shard's flash PARTIAL over its own blocks
+    (rows with no local blocks emit the ``(0, -inf)`` merge identity).
     """
     B, Hq, Tq, D = q.shape
+    if local_blocks and block_table is None:
+        raise ValueError("local_blocks requires block_table")
     if tree_mask is not None:
         if not causal:
             raise ValueError("tree_mask requires causal=True")
@@ -1163,10 +1196,11 @@ def attention_pallas_decode(
                 kernel="paged_q8" if k.dtype == jnp.int8 else "paged"
             ).inc()
         tensors = [qp, k, v]
+        kv_map = _paged_kv_map(Hkv, local=local_blocks)
         in_specs = [
             pl.BlockSpec((1, bq, D), _paged_q_map),
-            pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv)),
-            pl.BlockSpec((1, 1, k.shape[2], D), _paged_kv_map(Hkv)),
+            pl.BlockSpec((1, 1, k.shape[2], D), kv_map),
+            pl.BlockSpec((1, 1, k.shape[2], D), kv_map),
         ]
         if tree_mask is not None:
             tensors.insert(1, _tree_bits_rows(tree_mask, G, Hkv, bq, n_q))
@@ -1177,7 +1211,8 @@ def attention_pallas_decode(
             _flash_decode_paged_kernel,
             dict(scale=s, causal=causal, tq=Tq, block_q=bq,
                  block_k=k.shape[2], n_kv_heads=Hkv,
-                 tree=tree_mask is not None),
+                 tree=tree_mask is not None,
+                 local_blocks=local_blocks),
             tensors,
             in_specs,
             q_offset=q_offset, kv_offset=kv_offset,

@@ -397,6 +397,132 @@ def tree_decode(
     )
 
 
+def paged_tree_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_table: jax.Array,
+    *,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    data_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+    q_position=None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Block-table-aware tree decode over a sequence-SHARDED paged pool
+    (ISSUE 18): the serving-side realisation of the paper's monoid.
+
+    Args:
+      q: ``(B, Hq, Tq, D)``, replicated over ``seq_axis``.
+      k, v: one layer's pool slice ``(N, Hkv, block, D)`` sharded along
+        dim 0 (the block axis) over ``seq_axis`` — shard ``s`` of ``W``
+        owns GLOBAL block ids ``[s·N/W, (s+1)·N/W)``, the same
+        range-partition rule the host's ``ShardedBlockAllocator`` hands
+        ids out under, so host placement and device layout agree by
+        construction.
+      block_table: ``(B, NB)`` int32 of GLOBAL block ids (the one table
+        every shard shares — replicated, like the host's bookkeeping).
+        Each shard rebases it to local ids and CULLS entries outside its
+        own range; a logical block therefore contributes keys on exactly
+        one shard, and the union over shards is exactly the replicated
+        logical view.
+      q_position: per-slot ``(B,)`` first-query positions (required — the
+        ragged serving shape).
+      k_scale, v_scale: optional per-block int8 scales ``(N, Hkv)``
+        sharded WITH the pool slice (dim 0); selects the dequantizing
+        local partial.
+
+    Each shard computes :func:`~tree_attention_tpu.ops.decode
+    .paged_local_partial` over only its local blocks, then the merge is
+    exactly the tree-attention decode monoid — **one MAX and two SUM
+    collectives** on the ``(res, lse)`` partials: ``pmax`` over the lse
+    rows (inside :func:`_weigh`), then one ``psum`` over the weighted
+    numerator and one over the denominator. Deliberately NOT the fused
+    ``psum((num, den))`` of :func:`_merge_across`: the 3-collective shape
+    is the paper's monoid stated as collectives, and the accounting entry
+    below (algorithm ``"paged_tree_decode"``, collectives ``pmax`` /
+    ``psum_num`` / ``psum_den``) is the countable artifact the serving
+    bench asserts against.
+
+    Returns ``(out, lse)`` with q's sharding (replicated over
+    ``seq_axis``).
+    """
+    from tree_attention_tpu.ops.decode import paged_local_partial
+
+    if getattr(q_position, "ndim", 0) != 1:
+        raise ValueError(
+            "paged_tree_decode needs a per-slot (B,) q_position"
+        )
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    n_shards = mesh.shape[seq_axis]
+    N = k.shape[0]
+    if N % n_shards:
+        raise ValueError(
+            f"pool of {N} blocks must divide over {n_shards} "
+            f"'{seq_axis}' shards (init_paged_cache rounds up)"
+        )
+    n_local = N // n_shards
+
+    q_spec = P(data_axis, head_axis, None, None)
+    pool_spec = P(seq_axis, head_axis, None, None)
+    scale_spec = P(seq_axis, head_axis)
+    in_specs = (
+        (q_spec, pool_spec, pool_spec, P(data_axis, None), P(data_axis))
+        + ((scale_spec, scale_spec) if quant else ())
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(q_spec, P(data_axis, head_axis, None)),
+        check_vma=False,
+    )
+    def _sharded(q_l, k_l, v_l, tbl, q_pos, *scales):
+        shard = lax.axis_index(seq_axis)
+        loc = tbl - shard * n_local
+        # Signed local-table convention (see paged_local_partial):
+        # entries outside this shard's range go negative — the per-slot
+        # cull against the shard's local coverage.
+        loc = jnp.where((loc >= 0) & (loc < n_local), loc, -1)
+        out, lse = paged_local_partial(
+            q_l, k_l, v_l, loc, q_position=q_pos, scale=scale,
+            k_scale=scales[0] if quant else None,
+            v_scale=scales[1] if quant else None,
+        )
+        num, den, m = _weigh(out, lse, seq_axis)
+        num = lax.psum(num, seq_axis)
+        den = lax.psum(den, seq_axis)
+        return _finalize_merge(num, den, m, q.dtype)
+
+    # Merge wire accounting: the decode merge moves O(B·H·Tq·D) per tick
+    # regardless of context — one f32 pmax over the lse rows and two
+    # psums (numerator tile, denominator row). Exactly 3 collective
+    # labels: the bench's "3 collectives per decode tick" assertion
+    # counts THESE entries.
+    B, Hq, Tq, D = q.shape
+    d_sh, h_sh = _shard_counts(mesh, data_axis, head_axis)
+    lse_bytes = 4 * -(-B // d_sh) * -(-Hq // h_sh) * Tq
+    _account_payload(
+        "paged_tree_decode",
+        pmax=lse_bytes,
+        psum_num=4 * -(-B // d_sh) * -(-Hq // h_sh) * Tq * D,
+        psum_den=lse_bytes,
+    )
+    args = (q, k, v, block_table, jnp.asarray(q_position, jnp.int32))
+    if quant:
+        args = args + (k_scale, v_scale)
+    with obs.span("paged_tree_decode", cat="dispatch",
+                  args=None if not obs.TRACER.active else
+                  {"blocks": N, "shards": n_shards}):
+        return _sharded(*args)
+
+
 def tree_decode_q8(
     q: jax.Array,
     k_q: jax.Array,

@@ -19,7 +19,10 @@ from tree_attention_tpu.serving.engine import (  # noqa: F401
     StaticRequestSource,
     synthetic_trace,
 )
-from tree_attention_tpu.serving.block_pool import BlockAllocator  # noqa: F401
+from tree_attention_tpu.serving.block_pool import (  # noqa: F401
+    BlockAllocator,
+    ShardedBlockAllocator,
+)
 from tree_attention_tpu.serving.disagg import DisaggServer  # noqa: F401
 from tree_attention_tpu.serving.fleet import (  # noqa: F401
     FleetSupervisor,

@@ -50,6 +50,19 @@ _BLOCKS_FREE = obs.gauge(
     "serving_kv_blocks_free",
     "unified KV pool blocks on the free list",
 )
+# Per-shard views of the same ledger (sequence-sharded pool, ISSUE 18):
+# the aggregate gauges above keep their unlabeled contract; these expose
+# the shard split so /metrics shows placement imbalance directly.
+_BLOCKS_USED_SHARD = obs.gauge(
+    "serving_kv_blocks_used_shard",
+    "KV pool blocks owned per mesh shard (sequence-sharded pool)",
+    labels=("shard",),
+)
+_BLOCKS_FREE_SHARD = obs.gauge(
+    "serving_kv_blocks_free_shard",
+    "KV pool blocks free per mesh shard (sequence-sharded pool)",
+    labels=("shard",),
+)
 
 # Block ownership states (the debug ledger's vocabulary). A _DEMOTED
 # block is owned by the host tier's staging queue: the radix tree evicted
@@ -108,6 +121,19 @@ class BlockAllocator:
         self._flush_demotions: Optional[Callable[[], int]] = None
         self.demote_batch = 8
 
+    # -- the free list (subclass seam) ------------------------------------
+    #
+    # Every free-list touch goes through these two hooks so a subclass can
+    # swap the backing structure (ShardedBlockAllocator keeps one list per
+    # mesh shard) without re-deriving any of the ownership transitions or
+    # the reservation-soundness argument above.
+
+    def _push_free(self, bid: int) -> None:
+        self._free.append(bid)
+
+    def _pop_free(self) -> int:
+        return self._free.pop()
+
     # -- introspection ----------------------------------------------------
 
     @property
@@ -116,7 +142,7 @@ class BlockAllocator:
 
     @property
     def used(self) -> int:
-        return self.blocks - len(self._free)
+        return self.blocks - self.free_count
 
     def evictable(self) -> int:
         return self._evictable() if self._evictable is not None else 0
@@ -124,12 +150,12 @@ class BlockAllocator:
     def available(self) -> int:
         """Blocks an admission may still reserve: free + evictable-now,
         minus what earlier admissions already promised themselves."""
-        return len(self._free) + self.evictable() - self.reserved
+        return self.free_count + self.evictable() - self.reserved
 
     def publish_gauges(self) -> None:
         if obs.REGISTRY.enabled:
             _BLOCKS_USED.set(self.used)
-            _BLOCKS_FREE.set(len(self._free))
+            _BLOCKS_FREE.set(self.free_count)
 
     # -- the evictor hook (the radix tree) --------------------------------
 
@@ -176,7 +202,7 @@ class BlockAllocator:
         and pins (which shrink evictability) are themselves reserved."""
         assert self.reserved > 0, "alloc without a backing reservation"
         self.reserved -= 1
-        while not self._free:
+        while not self.free_count:
             # Load-bearing calls — NOT inside an assert (python -O strips
             # assert statements, and the eviction must still run). With a
             # host tier, evict_one() DEMOTES (the block parks in state
@@ -184,19 +210,19 @@ class BlockAllocator:
             # small batch of leaves and flushes the staged D2H once —
             # one jitted gather per batch, not one sync per block.
             n = 0
-            while not self._free and n < self.demote_batch:
+            while not self.free_count and n < self.demote_batch:
                 if self._evict_one is None or not self._evict_one():
                     break
                 n += 1
-            if not self._free and self._flush_demotions is not None \
+            if not self.free_count and self._flush_demotions is not None \
                     and self._flush_demotions() > 0:
                 continue
-            if not self._free:
+            if not self.free_count:
                 raise AssertionError(
                     "allocator invariant broken: a backed reservation "
                     "found neither a free block nor an evictable leaf"
                 )
-        bid = self._free.pop()
+        bid = self._pop_free()
         assert self._state[bid] == _FREE, f"block {bid} double-allocated"
         self._state[bid] = _PRIVATE
         return bid
@@ -215,7 +241,7 @@ class BlockAllocator:
             f"block {bid} freed while not privately owned"
         )
         self._state[bid] = _FREE
-        self._free.append(bid)
+        self._push_free(bid)
         self.gen += 1
 
     def unmap_private(self, bid: int) -> None:
@@ -229,7 +255,7 @@ class BlockAllocator:
             f"block {bid} unmapped while not privately owned"
         )
         self._state[bid] = _FREE
-        self._free.append(bid)
+        self._push_free(bid)
         self.reserved += 1
 
     # -- copy-on-write fork sharing (ISSUE 15) ----------------------------
@@ -286,7 +312,7 @@ class BlockAllocator:
             return
         del self._shared_refs[bid]
         self._state[bid] = _FREE
-        self._free.append(bid)
+        self._push_free(bid)
         self.gen += 1
 
     def transfer_private(self, bids: Iterable[int]) -> int:
@@ -325,7 +351,7 @@ class BlockAllocator:
             f"block {bid} evicted while not tree-owned"
         )
         self._state[bid] = _FREE
-        self._free.append(bid)
+        self._push_free(bid)
         self.gen += 1
 
     # -- the host tier's transitions (ISSUE 13) ---------------------------
@@ -359,5 +385,75 @@ class BlockAllocator:
             f"block {bid} flushed while not staged for demotion"
         )
         self._state[bid] = _FREE
-        self._free.append(bid)
+        self._push_free(bid)
         self.gen += 1
+
+
+class ShardedBlockAllocator(BlockAllocator):
+    """The sequence-sharded pool's ledger (ISSUE 18): ``blocks`` global
+    block ids range-partitioned over ``shards`` mesh shards — shard ``s``
+    owns ids ``[s*Nl, (s+1)*Nl)`` with ``Nl = blocks // shards``, the SAME
+    rule the device pool uses to map a global table entry to a local slice
+    row, so the host ledger and the device placement can never disagree.
+
+    One free list per shard; :meth:`alloc` pops from the RICHEST shard so
+    a growing slot's blocks interleave across shards and every shard
+    carries ~1/W of each slot's keys (balanced flash partials, balanced
+    pool pressure). Everything else — ownership states, eviction,
+    demotion, CoW sharing — is inherited untouched.
+
+    Reservations stay GLOBAL, which keeps them sound: any block can serve
+    any slot through the table indirection (placement only decides which
+    pool slice the bytes land in), so ``available()`` over the pooled free
+    count is exactly the guarantee :meth:`alloc` needs. Per-shard
+    reservations would be strictly weaker bookkeeping for zero safety.
+    """
+
+    def __init__(self, blocks: int, shards: int):
+        if shards < 1:
+            raise ValueError(f"need >= 1 shard, got {shards}")
+        if blocks % shards:
+            raise ValueError(
+                f"pool of {blocks} blocks does not split over {shards} "
+                f"shards — round the pool up first"
+            )
+        self.shards = shards
+        self.shard_blocks = blocks // shards
+        super().__init__(blocks)
+        nl = self.shard_blocks
+        self._free_by_shard: List[List[int]] = [
+            list(range((s + 1) * nl - 1, s * nl - 1, -1))
+            for s in range(shards)
+        ]
+        self._free = []  # unused; the per-shard lists are the free list
+
+    def shard_of(self, bid: int) -> int:
+        return bid // self.shard_blocks
+
+    def _push_free(self, bid: int) -> None:
+        self._free_by_shard[bid // self.shard_blocks].append(bid)
+
+    def _pop_free(self) -> int:
+        rich = max(
+            range(self.shards), key=lambda s: len(self._free_by_shard[s])
+        )
+        return self._free_by_shard[rich].pop()
+
+    @property
+    def free_count(self) -> int:
+        return sum(len(f) for f in self._free_by_shard)
+
+    def free_per_shard(self) -> List[int]:
+        return [len(f) for f in self._free_by_shard]
+
+    def used_per_shard(self) -> List[int]:
+        return [self.shard_blocks - len(f) for f in self._free_by_shard]
+
+    def publish_gauges(self) -> None:
+        super().publish_gauges()
+        if obs.REGISTRY.enabled:
+            for s, nfree in enumerate(self.free_per_shard()):
+                _BLOCKS_FREE_SHARD.labels(shard=s).set(nfree)
+                _BLOCKS_USED_SHARD.labels(shard=s).set(
+                    self.shard_blocks - nfree
+                )

@@ -83,7 +83,10 @@ from jax.sharding import Mesh
 from tree_attention_tpu import obs
 from tree_attention_tpu.obs.flight import FLIGHT
 from tree_attention_tpu.models.transformer import Params, TransformerConfig
-from tree_attention_tpu.serving.block_pool import BlockAllocator
+from tree_attention_tpu.serving.block_pool import (
+    BlockAllocator,
+    ShardedBlockAllocator,
+)
 from tree_attention_tpu.serving.engine import (
     OUTCOME_BUDGET,
     OUTCOME_CANCELLED,
@@ -181,6 +184,7 @@ class DisaggServer:
         draft_k: int = 4,
         drafter: Union[str, Drafter, None] = None,
         host_blocks: int = 0,
+        kv_shard: str = "replicated",
     ):
         if prefill_slots < 1 or decode_slots < 1:
             raise ValueError(
@@ -205,7 +209,23 @@ class DisaggServer:
         # ONE ledger for both workers: every reservation, allocation, and
         # ownership transition — including the handoff's transfer — runs
         # through this allocator, so the soundness audit covers the pair.
-        self.pool = BlockAllocator(self.kv_blocks)
+        # Under kv_shard="seq" (ISSUE 18) the ledger is the sharded
+        # variant — the handoff still moves zero KV bytes because block
+        # ownership is a host-side notion regardless of which mesh shard
+        # physically holds a block's pool row.
+        if kv_shard not in ("replicated", "seq"):
+            raise ValueError(
+                f"kv_shard must be 'replicated' or 'seq', got {kv_shard!r}"
+            )
+        self.kv_shard = kv_shard
+        if kv_shard == "seq":
+            from tree_attention_tpu.parallel.mesh import AXIS_SEQ
+
+            w = max(mesh.shape.get(AXIS_SEQ, 1), 1) if mesh is not None else 1
+            self.kv_blocks = -(-self.kv_blocks // w) * w
+            self.pool = ShardedBlockAllocator(self.kv_blocks, w)
+        else:
+            self.pool = BlockAllocator(self.kv_blocks)
         if host_blocks < 0:
             raise ValueError(f"host_blocks must be >= 0, got {host_blocks}")
         if host_blocks and not prefix_cache:
@@ -246,6 +266,7 @@ class DisaggServer:
             top_k=top_k,
             admission="chunked", slo_ttft=slo_ttft, slo_tbt=slo_tbt,
             slo_window=slo_window, kv_layout="paged", kv_block=kv_block,
+            kv_shard=kv_shard,
             block_pool=self.pool, prefix_index=self.prefix_index,
         )
         self.prefill = SlotServer(

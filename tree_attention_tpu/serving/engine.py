@@ -159,7 +159,10 @@ from tree_attention_tpu.models.decode import (
     sample_slots,
     scatter_kv_blocks,
 )
-from tree_attention_tpu.serving.block_pool import BlockAllocator
+from tree_attention_tpu.serving.block_pool import (
+    BlockAllocator,
+    ShardedBlockAllocator,
+)
 from tree_attention_tpu.serving.host_pool import HostBlockPool
 from tree_attention_tpu.serving.prefix_cache import TIER_DEVICE
 from tree_attention_tpu.serving.speculation import (
@@ -761,6 +764,7 @@ class SlotServer:
         kv_layout: str = "paged",
         kv_block: Optional[int] = None,
         kv_blocks: Optional[int] = None,
+        kv_shard: str = "replicated",
         speculate: bool = False,
         draft_k: int = 4,
         drafter: Union[str, Drafter, None] = None,
@@ -778,6 +782,16 @@ class SlotServer:
             raise ValueError(
                 f"kv_layout must be 'paged' or 'contiguous', "
                 f"got {kv_layout!r}"
+            )
+        if kv_shard not in ("replicated", "seq"):
+            raise ValueError(
+                f"kv_shard must be 'replicated' or 'seq', got {kv_shard!r}"
+            )
+        if kv_shard == "seq" and kv_layout != "paged":
+            raise ValueError(
+                "kv_shard='seq' shards the paged block pool; the "
+                "contiguous layout already shards the token axis via "
+                "the mesh"
             )
         if block_pool is not None and kv_layout != "paged":
             raise ValueError(
@@ -875,6 +889,11 @@ class SlotServer:
 
         kw = {"mesh": mesh} if mesh is not None else {}
         self._fs_kw = dict(kw)
+        if kv_shard == "seq":
+            # Only the batched per-tick steps run on the sharded pool;
+            # the B=1 prefill/staging programs below use CONTIGUOUS
+            # mini-caches and must not see the flag.
+            self._fs_kw["kv_shard"] = "seq"
         # B=1 programs (the legacy mini-cache prefill and the quantized
         # staging cache) cannot shard over a data axis (1 does not divide
         # it) — and need no data parallelism anyway; the batched per-tick
@@ -889,6 +908,14 @@ class SlotServer:
             self._seq_shards = max(mesh.shape.get(AXIS_SEQ, 1), 1)
         self.kv_layout = kv_layout
         self._paged = kv_layout == "paged"
+        # Sequence-sharded pool (ISSUE 18): per-device pool bytes drop to
+        # 1/W; the allocator range-partitions global block ids over the
+        # mesh's seq shards and decode attention runs the shard_map'd
+        # 3-collective tree merge. Host bookkeeping (tables, radix keys,
+        # private/shared sets) stays in GLOBAL ids throughout — the shard
+        # rebase happens only inside the device-side shard_map bodies.
+        self.kv_shard = kv_shard
+        self._kv_seq_sharded = kv_shard == "seq" and self._seq_shards > 1
         # Bytes a contiguous-layout hit gathers per matched token — the
         # cost a paged hit deletes (the bytes_moved span arg).
         self._kv_token_bytes = (
@@ -926,13 +953,33 @@ class SlotServer:
                         f"kv_blocks {kv_blocks} contradicts the shared "
                         f"block_pool's capacity {block_pool.blocks}"
                     )
+                if kv_shard == "seq" and (
+                    not isinstance(block_pool, ShardedBlockAllocator)
+                    or block_pool.shards != self._seq_shards
+                ):
+                    # Both workers' device pools must agree on the id →
+                    # shard placement rule, and the shared ledger is
+                    # where that rule lives.
+                    raise ValueError(
+                        "kv_shard='seq' with a shared block_pool needs a "
+                        f"ShardedBlockAllocator over {self._seq_shards} "
+                        "shards (one placement rule for every worker)"
+                    )
                 self.kv_blocks = block_pool.blocks
                 self._pool = block_pool
             else:
                 self.kv_blocks = (
                     slots * self._npb if kv_blocks is None else kv_blocks
                 )
-                self._pool = BlockAllocator(self.kv_blocks)
+                if kv_shard == "seq":
+                    # Round UP to a whole number of per-shard slices: the
+                    # device pool and the ledger must split evenly, and
+                    # extra blocks only ever ADD capacity.
+                    w = self._seq_shards
+                    self.kv_blocks = -(-self.kv_blocks // w) * w
+                    self._pool = ShardedBlockAllocator(self.kv_blocks, w)
+                else:
+                    self._pool = BlockAllocator(self.kv_blocks)
             # KV tiering (ISSUE 13): the host-RAM demotion tier under
             # the device pool. Created here (the prefix index attaches
             # to it below); the allocator's flusher hook lets a dry
@@ -975,7 +1022,7 @@ class SlotServer:
             cache: Union[KVCache, QuantKVCache, PagedKVCache,
                          PagedQuantKVCache] = init_paged_cache(
                 cfg, slots, cache_len, self.kv_blocks,
-                block=kv_block, quantize=quantize, **kw
+                block=kv_block, quantize=quantize, kv_shard=kv_shard, **kw
             )
         else:
             self.host_blocks = 0
@@ -1183,7 +1230,8 @@ class SlotServer:
         # (ISSUE 13) — both fall back to root-path chains there.
         self._tree_ok = not (
             self._seq_shards > 1
-            and (kv_layout == "contiguous" or quantize)
+            and (kv_layout == "contiguous" or quantize
+                 or kv_shard == "seq")
         )
         # Verify chunks ride power-of-two Tq buckets like prefill chunks;
         # the bucket must fit the cache's write window, so the draft size

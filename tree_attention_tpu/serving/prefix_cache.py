@@ -429,6 +429,14 @@ class PagedPrefixIndex(_RadixBase):
     grow to whatever the pool's eviction pressure allows. The index
     registers itself as the allocator's evictor, so slot allocations
     under a full free list recycle LRU refcount-0 leaves automatically.
+
+    **Sequence-sharded pools (ISSUE 18)** need no changes here: radix
+    keys are host-side token tuples and node payloads are GLOBAL block
+    ids — which mesh shard physically holds a block's pool row is an
+    allocator detail (``ShardedBlockAllocator.shard_of``), invisible to
+    matching, pinning, adoption, and eviction. A hit under
+    ``kv_shard="seq"`` is the same host-side table update; the decode
+    merge finds the reused rows wherever they live.
     """
 
     def __init__(self, *, block: int, alloc: "BlockAllocator",

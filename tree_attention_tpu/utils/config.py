@@ -101,6 +101,10 @@ class RunConfig:
     #                                 prefix-block with the cache on, else 64)
     kv_blocks: Optional[int] = None  # TOTAL pool capacity in blocks (None ->
     #                                  slots * ceil(cache_len / kv_block))
+    kv_shard: str = "replicated"  # replicated | seq — 'seq' range-partitions
+    #                               the paged pool (and its allocator) across
+    #                               the mesh's seq axis; decode merges shard
+    #                               partials with the tree monoid (ISSUE 18)
     # Hierarchical KV tiering (ISSUE 13): radix eviction demotes blocks
     # onto a host-RAM tier instead of freeing them; a later prefix hit
     # restores them with one batched H2D scatter.
@@ -333,6 +337,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "kv_block), the contiguous layout's bytes). "
                         "Smaller over-subscribes: admissions wait for "
                         "free blocks instead of failing")
+    p.add_argument("--kv-shard", choices=["replicated", "seq"],
+                   default=d.kv_shard,
+                   help="serve mode: 'seq' range-partitions the paged KV "
+                        "pool across the mesh's sequence axis — each "
+                        "shard holds blocks/W pool rows plus its own "
+                        "free-list shard, decode computes per-shard "
+                        "flash partials over LOCAL blocks only and "
+                        "merges them with the tree monoid (one pmax + "
+                        "two psum per tick). Max servable context grows "
+                        "~linearly with W at fixed per-device KV bytes. "
+                        "Requires --kv-layout paged; 'replicated' "
+                        "(default) keeps the pool on every shard")
     p.add_argument("--host-blocks", type=int, default=d.host_blocks,
                    help="serve mode: host-RAM KV tier capacity in blocks "
                         "(0 = no tier). With the paged layout + prefix "
