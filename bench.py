@@ -744,6 +744,24 @@ def _serving_forked_record():
     return bench_serving_forked_sampling()
 
 
+def _serving_tree_record():
+    """Token-tree sibling decode (ISSUE 20): an n=8 family decoded as
+    ONE tree-masked row bundle in ONE slot (SpecInfer's tree aimed at
+    sibling futures, arXiv:2305.09781) vs the PR-15 fork-slot path at
+    equal pool bytes — pool_bytes_ratio <= 1.0 asserted, burst
+    max-concurrent and per-branch TTFT p50 ratios reported. Parity-gated
+    both ways: tree branches token-identical to fork slots under the
+    same seed, bit-reproducible across serves. Plus the stochastic
+    speculative-acceptance distribution gate: spec-on temperature-0.8
+    decode (Leviathan ratio test, arXiv:2211.17192) asserted bit-equal
+    to the non-speculative sampled stream. CPU proxy; the slot/pool
+    economics are ledger math and transfer exactly. See
+    tree_attention_tpu/bench/serving.py."""
+    from tree_attention_tpu.bench.serving import bench_serving_tree_sampling
+
+    return bench_serving_tree_sampling()
+
+
 def _serving_telemetry_record():
     """Request-telemetry overhead (ISSUE 16): the fleet trace replayed
     through the router with end-to-end request telemetry ON (traceparent
@@ -1056,6 +1074,7 @@ def _run_suite() -> None:
     run("serving_disagg", _serving_disagg_record)
     run("serving_tiered_kv", _serving_tiered_record)
     run("serving_forked_sampling", _serving_forked_record)
+    run("serving_tree_sampling", _serving_tree_record)
     run("serving_request_telemetry", _serving_telemetry_record)
     run("serving_seq_sharded", _serving_seq_sharded_record)
     run("ici_crossover", _ici_crossover_record, suite)
@@ -1226,6 +1245,18 @@ def _summarize_record(name, rec):
         ratio = rec.get("trace", {}).get("ttft_p50_ratio")
         if ratio is not None:
             out["fork_ttft_p50_ratio"] = ratio
+    if name == "serving_tree_sampling":
+        fam = rec.get("family", {})
+        if "pool_bytes_ratio" in fam:
+            out["tree_pool_bytes_ratio"] = fam["pool_bytes_ratio"]
+        tr = rec.get("trace", {})
+        for key in ("max_concurrent_improvement", "tokens_per_sec_ratio",
+                    "ttft_p50_ratio"):
+            if key in tr:
+                out[key] = tr[key]
+        acc = rec.get("stochastic", {}).get("acceptance_rate")
+        if acc is not None:
+            out["stochastic_acceptance_rate"] = acc
     if name == "serving_request_telemetry":
         ov = rec.get("overhead", {})
         for key in ("tokens_per_sec_ratio", "ttft_p50_ratio"):

@@ -155,6 +155,20 @@ _SPEC.loader.exec_module(bc)
     ("blocks_per_device", None),
     ("kv_block", None),
     ("max_new_tokens_streamed", None),
+    # Token-tree sibling family (ISSUE 20): the tree-over-fork pool
+    # ratio and per-branch TTFT ratio are smaller-is-better, the burst
+    # concurrency improvement and stochastic acceptance rate
+    # larger-is-better; per-arm block/byte echoes (deterministic ledger
+    # math) and family/drafter shape skip.
+    ("tree_pool_bytes_ratio", bc.SMALLER_IS_BETTER),
+    ("stochastic_acceptance_rate", bc.LARGER_IS_BETTER),
+    ("peak_blocks_tree", None),
+    ("peak_blocks_fork", None),
+    ("pool_bytes_tree", None),
+    ("pool_bytes_fork", None),
+    ("families", None),
+    ("draft_k", None),
+    ("proposed", None),
 ])
 def test_classify_families(key, family):
     assert bc.classify(key) == family

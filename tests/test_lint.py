@@ -621,6 +621,26 @@ class TestPallasContract:
         ), path=OPS_DECODE)
         assert fs == []
 
+    def test_sibling_packer_needs_limit_check(self):
+        # ISSUE 20: the sibling-row packer feeds the same int32 tree
+        # bitmasks — it must carry its own rows <= 32 guard.
+        spec_path = "tree_attention_tpu/serving/speculation.py"
+        base = (
+            "def pack_siblings(suffixes):\n"
+            "{guard}"
+            "    return _pack(suffixes)\n"
+        )
+        bad = base.format(guard="")
+        good = base.format(guard=(
+            "    rows = sum(len(s) for s in suffixes)\n"
+            "    assert rows <= 32, 'sibling bundle too wide'\n"))
+        fs = run("pallas-contract", bad, path=spec_path)
+        assert len(fs) == 1 and "pack_siblings" in fs[0].message
+        assert run("pallas-contract", good, path=spec_path) == []
+        # The packer rule is scoped to speculation.py; engine callers
+        # ride the eligibility gates instead of per-call checks.
+        assert run("pallas-contract", bad, path=ENGINE) == []
+
 
 # ---------------------------------------------------------------------------
 # lock-safety
@@ -1747,6 +1767,20 @@ class TestMirrorDrift:
         before = ast.dump(dis.tree)
         lintlib.PASSES["mirror-drift"](dis)
         assert ast.dump(dis.tree) == before
+
+    def test_singleton_token_nodes_carry_no_parent(self):
+        # Perf fix (ISSUE 20): Load/Store/operator nodes are PARSER
+        # SINGLETONS shared module-wide; stamping _lint_parent on one
+        # aims it at the module's last user, and the region deepcopy
+        # follows the pointer into an arbitrary module-sized parent
+        # chain (the whole-repo lint blew its 10 s budget as engine.py
+        # grew). Source must leave them unannotated.
+        import ast
+        src = lintlib.Source("x.py", "a = b + c\nd = [e for e in f]\n")
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.expr_context, ast.boolop,
+                                 ast.operator, ast.unaryop, ast.cmpop)):
+                assert not hasattr(node, "_lint_parent"), type(node)
 
 
 # ---------------------------------------------------------------------------

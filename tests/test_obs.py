@@ -718,9 +718,12 @@ class TestDisabledOverhead:
         # objects (registered on the global, disabled registry).
         # The copy-on-write fork hooks (ISSUE 15) ride the same guard:
         # _fork_child bumps these only under REGISTRY.enabled.
+        # The token-tree sibling hooks (ISSUE 20) too: the branch gauge
+        # and the stochastic accept-sample counter.
         from tree_attention_tpu.serving.engine import (
             _FORKS, _FORK_SHARED,
             _SPEC_ACCEPTED, _SPEC_ACCEPT_RATIO, _SPEC_PROPOSED,
+            _SPEC_ACCEPT_SAMPLES, _TREE_BRANCHES,
         )
 
         def hot_path():
@@ -733,6 +736,8 @@ class TestDisabledOverhead:
             _SPEC_ACCEPT_RATIO.set(0.5)
             _FORKS.inc()
             _FORK_SHARED.inc(7)
+            _TREE_BRANCHES.set(8)
+            _SPEC_ACCEPT_SAMPLES.inc(4)
             with tracer.span("phase"):
                 pass
             tracer.instant("event")

@@ -420,12 +420,15 @@ def test_validation_rejects_unforkable_shapes(params):
         eng2._fork_ok = True
 
 
-def test_spec_engine_rejects_fork_and_sampling(params):
+def test_spec_engine_rejects_fork_allows_sampling(params):
     eng = engine(params, slots=2, speculate=True, draft_k=3)
     with pytest.raises(ValueError, match="speculate"):
         eng.serve([_req(0, _prompt(14), n=2)])
-    with pytest.raises(ValueError, match="greedy"):
-        eng.serve([_req(0, _prompt(14), temperature=0.7)])
+    # The pure-argmax restriction is LIFTED (ISSUE 20): sampled serving
+    # under speculation walks the stochastic accept path.
+    rep = eng.serve([_req(0, _prompt(14), n_new=4, temperature=0.7)])
+    assert rep.results[0].outcome == OUTCOME_BUDGET
+    assert len(rep.results[0].tokens) == 4
 
 
 # ---------------------------------------------------------------------------

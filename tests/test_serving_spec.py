@@ -55,8 +55,10 @@ from tree_attention_tpu.serving.speculation import (
     PromptLookupTreeDrafter,
     DraftModelDrafter,
     accept_longest_path,
+    accept_stochastic_path,
     make_drafter,
     pack_proposal,
+    pack_siblings,
 )
 
 CFG = TransformerConfig(
@@ -175,6 +177,40 @@ class TestProposalAndAccept:
         ))
         kept, com = accept_longest_path(tree, [2, 0, 3, 8])
         assert kept == [2, 3] and com == [2, 3, 8]
+
+    def test_stochastic_accept_is_the_same_walk_over_samples(self):
+        # The point-mass coupling (ISSUE 20): with SAMPLES in place of
+        # argmaxes the ratio test degenerates to the same child walk —
+        # accept iff the target's draw names the draft, else the draw
+        # itself is the residual emission.
+        chain = pack_proposal(9, DraftProposal(
+            np.array([1, 2, 3], np.int32), np.array([-1, 0, 1], np.int32)
+        ))
+        kept, com = accept_stochastic_path(chain, [1, 2, 7, 4])
+        assert kept == [1, 2] and com == [1, 2, 7]
+        kept, com = accept_stochastic_path(chain, [4, 0, 0, 0])
+        assert kept == [] and com == [4]
+
+    def test_pack_siblings_shape_and_limits(self):
+        pack = pack_siblings([[3, 4], [5, 6], [3, 7]])
+        assert pack.rows == 6
+        assert pack.row_tokens.tolist() == [3, 4, 5, 6, 3, 7]
+        assert pack.depth.tolist() == [0, 1, 0, 1, 0, 1]
+        assert pack.row_parents.tolist() == [-1, 0, -1, 2, -1, 4]
+        # Per-branch lower-triangular blocks, nothing across branches.
+        tril2 = np.tril(np.ones((2, 2), bool))
+        for r in range(3):
+            o = 2 * r
+            np.testing.assert_array_equal(pack.anc[o:o + 2, o:o + 2],
+                                          tril2)
+        off = ~np.kron(np.eye(3, dtype=bool), np.ones((2, 2), bool))
+        assert not pack.anc[off].any()
+        with pytest.raises(ValueError, match="equal length"):
+            pack_siblings([[1, 2], [3]])
+        with pytest.raises(ValueError, match=">= 1"):
+            pack_siblings([])
+        with pytest.raises(AssertionError, match="32-row"):
+            pack_siblings([list(range(11))] * 3)  # 33 rows
 
     def test_prompt_lookup_prefers_full_k_continuation(self):
         # tail [1, 2] recurs at position 0 (long continuation) and at
@@ -337,6 +373,57 @@ def test_tree_mask_pallas_matches_chunked_paged_and_contiguous():
                                      tree_mask=tm, interpret=True)
     np.testing.assert_allclose(np.asarray(o2), np.asarray(o_ref), atol=2e-6)
     np.testing.assert_allclose(np.asarray(l2), np.asarray(l_ref), atol=2e-6)
+
+
+def test_sibling_mask_rows_equal_independent_branches():
+    """The ISSUE-20 packing oracle: a ``pack_siblings`` bundle's rows
+    through the EXISTING tree-mask kernels equal k independent causal
+    decodes — branch r's rows see the frozen ancestors ``[0, pos)``
+    plus its own suffix only, exactly as if that suffix sat alone at
+    ``[pos, pos+s)``. No new kernel; the block-diagonal mask is the
+    whole mechanism."""
+    from tree_attention_tpu.ops.pallas_decode import attention_pallas_decode
+
+    rng = np.random.default_rng(5)
+    Hq, Hkv, D, cap, pos = 4, 2, 16, 64, 19
+    k_br, s = 3, 4
+    Tq = k_br * s
+    pack = pack_siblings([[0] * s] * k_br)  # tokens unused at ops level
+    q = rng.standard_normal((1, Hq, Tq, D)).astype(np.float32)
+    kv_k = rng.standard_normal((1, Hkv, cap, D)).astype(np.float32)
+    kv_v = rng.standard_normal((1, Hkv, cap, D)).astype(np.float32)
+    tm = jnp.asarray(pack.anc)[None]
+    out, lse = flash_decode(
+        jnp.asarray(q), jnp.asarray(kv_k), jnp.asarray(kv_v),
+        q_position=jnp.asarray([pos], jnp.int32), num_splits=2,
+        tree_mask=tm,
+    )
+    op, lp = attention_pallas_decode(
+        jnp.asarray(q), jnp.asarray(kv_k), jnp.asarray(kv_v),
+        causal=True, q_offset=jnp.asarray([pos], jnp.int32),
+        tree_mask=tm, interpret=True,
+    )
+    for r in range(k_br):
+        o = r * s
+        # The branch alone: its suffix KV moved to the contiguous
+        # window [pos, pos+s), everything behind pos untouched.
+        bk, bv = kv_k.copy(), kv_v.copy()
+        bk[:, :, pos:pos + s] = kv_k[:, :, pos + o:pos + o + s]
+        bv[:, :, pos:pos + s] = kv_v[:, :, pos + o:pos + o + s]
+        o_ref, l_ref = flash_decode(
+            jnp.asarray(q[:, :, o:o + s]), jnp.asarray(bk),
+            jnp.asarray(bv),
+            q_position=jnp.asarray([pos], jnp.int32), num_splits=2,
+        )
+        for got_o, got_l in ((out, lse), (op, lp)):
+            np.testing.assert_allclose(
+                np.asarray(got_o[:, :, o:o + s]), np.asarray(o_ref),
+                atol=2e-6,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got_l[:, :, o:o + s]), np.asarray(l_ref),
+                atol=2e-6,
+            )
 
 
 def test_forward_step_tree_rows_equal_per_path_sequential(params):
@@ -790,10 +877,12 @@ def test_block_allocator_unmap_private_restores_reservation():
     assert a.used == 0 and a.reserved == 0
 
 
-def test_speculate_rejects_sampling_and_bad_draft_k(params):
-    with pytest.raises(ValueError, match="greedy"):
-        SlotServer(params, CFG, slots=1, cache_len=32, speculate=True,
-                   temperature=0.5)
+def test_speculate_allows_sampling_rejects_bad_draft_k(params):
+    # The pure-argmax restriction is LIFTED (ISSUE 20): a sampling
+    # spec engine constructs fine and serves via the stochastic
+    # accept walk (distribution parity tested below).
+    SlotServer(params, CFG, slots=1, cache_len=32, speculate=True,
+               temperature=0.5)
     with pytest.raises(ValueError, match="draft_k"):
         SlotServer(params, CFG, slots=1, cache_len=32, speculate=True,
                    draft_k=0)

@@ -1,0 +1,416 @@
+"""Token-tree sibling decode tests (ISSUE 20): n>1 sampling in ONE
+slot, plus stochastic speculative acceptance.
+
+Five contracts:
+
+(a) **Parity** — an n = k family decoded as a token tree (sibling
+    branches packed into one verify-shaped row bundle in a single
+    slot) is token-for-token identical to the PR-15 fork-slot path
+    under the same seed, across exact/int8 and single-device/compat
+    cpu_mesh. The per-branch PRNG chain is the same
+    ``fold_in(fold_in(fold_in(base, salt), branch), index)`` either
+    way, so this is a pure packing/attention equivalence gate.
+(b) **Occupancy** — the whole family fits ONE slot: n=8 serves on a
+    slots=1 engine (impossible on the fork path, which needs a slot
+    per branch) at no more peak pool blocks than the fork path.
+(c) **Branch retire** — a sibling hitting EOS/budget mid-tick
+    returns its CoW tail blocks and unspent reservation the same
+    tick; every arc (EOS, cancel-mid-tree, best-of) drains to
+    0 private / 0 shared / 0 reserved / 0 pins.
+(d) **Stochastic acceptance** — spec-on SAMPLED serving uses the
+    Leviathan ratio test under deterministic stream keys: emitted
+    tokens are bit-identical to the non-spec sampled stream for the
+    same seed (the point-mass coupling), and re-serving reproduces
+    them bit-for-bit.
+(e) **Surfaces** — mid-generation ``fork_at`` converts a live slot
+    into a 2-branch tree, best-of streams only the winner, and the
+    REGISTRY/FLIGHT-guarded tree telemetry fires.
+
+Engines are memoized per flag shape and the configs stay tiny — the
+tier-1 budget rule.
+"""
+
+import json
+
+import numpy as np
+import pytest
+import jax
+
+from tree_attention_tpu.parallel import cpu_mesh
+from tree_attention_tpu.serving import SlotServer
+from tree_attention_tpu.serving.engine import (
+    OUTCOME_BUDGET,
+    OUTCOME_CANCELLED,
+    OUTCOME_EOS,
+)
+from tests.test_serving_fork import (
+    BASE_KW,
+    CACHE_LEN,
+    CFG,
+    ScriptedSource,
+    _prompt,
+    _req,
+    assert_drained,
+    params,  # noqa: F401  (module-scoped fixture re-export)
+)
+
+_ENGINES = {}
+
+
+def engine(params, **kw):
+    key = tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        merged = dict(BASE_KW)
+        merged.update(kw)
+        _ENGINES[key] = SlotServer(params, CFG, **merged)
+    return _ENGINES[key]
+
+
+def tree_eng(params, **kw):
+    return engine(params, slots=2, temperature=1.0, **kw)
+
+
+def fork_eng(params, slots=8, **kw):
+    return engine(params, slots=slots, temperature=1.0,
+                  tree_sampling=False, **kw)
+
+
+def _branches(rep):
+    return {r.index: r.tokens for r in rep.results}
+
+
+# ---------------------------------------------------------------------------
+# (a) parity vs the fork-slot path
+# ---------------------------------------------------------------------------
+
+
+def _tree_vs_fork(tree, fork, prompt, k, n_new, seed=7):
+    t = tree.serve([_req(0, prompt, n_new=n_new, n=k, seed=seed)])
+    assert t.kv.get("tree_families", 0) == 1, (
+        "tree path did not engage: " + repr(t.kv)
+    )
+    f = fork.serve([_req(0, prompt, n_new=n_new, n=k, seed=seed)])
+    assert "tree_families" not in f.kv
+    bt, bf = _branches(t), _branches(f)
+    assert sorted(bt) == sorted(bf) == list(range(k))
+    for j in range(k):
+        assert bt[j] == bf[j], (
+            f"branch {j} diverged from the fork-slot path: "
+            f"{bt[j]} != {bf[j]}"
+        )
+    lt = {r.index: r.cum_logprob for r in t.results}
+    lf = {r.index: r.cum_logprob for r in f.results}
+    for j in range(k):
+        assert np.isclose(lt[j], lf[j], rtol=1e-4, atol=1e-5)
+    assert_drained(tree)
+    assert_drained(fork)
+    return t, f
+
+
+def test_tree_n8_matches_fork_slots_exact(params):
+    """The acceptance gate: n=8 through ONE slot, token-identical to
+    eight fork slots, at no more peak pool blocks."""
+    t, f = _tree_vs_fork(tree_eng(params), fork_eng(params),
+                         _prompt(30, n=8), k=8, n_new=4)
+    assert len(set(tuple(r.tokens) for r in t.results)) >= 2, (
+        "sampled siblings never diverged — per-branch keys broken"
+    )
+    assert t.kv["peak_blocks_used"] <= f.kv["peak_blocks_used"], (
+        t.kv, f.kv,
+    )
+
+
+def test_tree_parity_unaligned_prompt(params):
+    # plen % kv_block != 0: the frozen-ancestor boundary falls
+    # mid-block and the replayed suffixes still line up.
+    _tree_vs_fork(tree_eng(params), fork_eng(params),
+                  _prompt(31, n=7), k=4, n_new=5, seed=3)
+
+
+def test_tree_parity_int8(params):
+    _tree_vs_fork(tree_eng(params, quantize=True),
+                  fork_eng(params, slots=4, quantize=True),
+                  _prompt(32, n=8), k=4, n_new=5, seed=9)
+
+
+def test_tree_mesh_parity(params):
+    """The tree bundle on a compat cpu_mesh reproduces the
+    single-device branches token-for-token."""
+    prompt = _prompt(33, n=8)
+    single = tree_eng(params).serve(
+        [_req(0, prompt, n_new=4, n=6, seed=5)]
+    )
+    assert single.kv.get("tree_families", 0) == 1
+    m = SlotServer(params, CFG, slots=2, temperature=1.0,
+                   mesh=cpu_mesh(2), **BASE_KW)
+    got = m.serve([_req(0, prompt, n_new=4, n=6, seed=5)])
+    assert got.kv.get("tree_families", 0) == 1
+    assert _branches(got) == _branches(single)
+    assert_drained(m)
+
+
+def test_tree_fixed_seed_bit_reproducible(params):
+    eng = tree_eng(params)
+    req = lambda: [_req(0, _prompt(34, n=8), n_new=4, n=6, seed=13)]
+    b1 = {r.index: tuple(r.tokens) for r in eng.serve(req()).results}
+    b2 = {r.index: tuple(r.tokens) for r in eng.serve(req()).results}
+    assert b1 == b2, "fixed-seed tree family not bit-reproducible"
+    assert_drained(eng)
+
+
+# ---------------------------------------------------------------------------
+# (b) occupancy: one slot, bounded pool
+# ---------------------------------------------------------------------------
+
+
+def test_tree_n8_fits_one_slot(params):
+    """n=8 on a slots=1 engine: only the tree path can serve it (the
+    fork path needs 8 slots and rejects at validation)."""
+    one = engine(params, slots=1, temperature=1.0)
+    rep = one.serve([_req(0, _prompt(35, n=8), n_new=4, n=8, seed=2)])
+    assert sorted(_branches(rep)) == list(range(8))
+    assert rep.kv["tree_families"] == 1
+    assert_drained(one)
+    forked = engine(params, slots=1, temperature=1.0,
+                    tree_sampling=False)
+    with pytest.raises(ValueError, match="exceed the engine"):
+        forked.serve([_req(0, _prompt(35, n=8), n_new=4, n=8)])
+
+
+def test_tree_oversize_family_falls_back_or_rejects(params):
+    """A family whose worst-case row bundle cannot fit the Tq cap or
+    the cache window must NOT silently engage the tree: within slot
+    count it falls back to fork slots, beyond it the validation error
+    still fires."""
+    eng = tree_eng(params)  # slots=2
+    # rows = 8*(6-1) = 40 > 32-row cap -> needs 8 fork slots > 2.
+    with pytest.raises(ValueError, match="exceed the engine"):
+        eng.serve([_req(0, _prompt(36, n=4), n_new=6, n=8)])
+    # k=2 fits the slot count, so the same overflow forks instead.
+    rep = eng.serve(
+        [_req(0, _prompt(36, n=4), n_new=CACHE_LEN - 8, n=2, seed=1)]
+    )
+    assert sorted(_branches(rep)) == [0, 1]
+    assert "tree_families" not in rep.kv
+    assert rep.kv["forks"] == 1
+    assert_drained(eng)
+
+
+# ---------------------------------------------------------------------------
+# (c) branch retire + leaks
+# ---------------------------------------------------------------------------
+
+
+def test_tree_branch_eos_retires_mid_tree(params):
+    """Force one sibling onto an early EOS: it retires with the token
+    included, the survivors run to budget, and the family still
+    drains (the same-tick trim returned its tail blocks)."""
+    eng = tree_eng(params)
+    prompt = _prompt(37, n=8)
+    ref = eng.serve([_req(0, prompt, n_new=4, n=4, seed=21)])
+    b = _branches(ref)
+    # Pick a token unique to one branch's interior so exactly that
+    # branch stops early; fall back to any interior token.
+    eos, victim = None, None
+    for j, toks in b.items():
+        for t in toks[:-1]:
+            if sum(t in o for o in b.values()) == 1:
+                eos, victim = t, j
+                break
+        if eos is not None:
+            break
+    if eos is None:
+        victim, eos = 0, b[0][0]
+    rep = eng.serve(
+        [_req(0, prompt, n_new=4, n=4, seed=21, eos_id=eos)]
+    )
+    got = {r.index: r for r in rep.results}
+    assert got[victim].outcome == OUTCOME_EOS
+    assert got[victim].tokens[-1] == eos
+    assert got[victim].tokens == b[victim][: len(got[victim].tokens)]
+    assert rep.outcomes.get(OUTCOME_EOS, 0) >= 1
+    assert_drained(eng)
+
+
+def test_tree_cancel_mid_family_retires_every_branch(params):
+    eng = tree_eng(params)
+    req = _req(0, _prompt(38, n=8), n_new=4, n=6, seed=4)
+    src = ScriptedSource(eng, [req], cancels={2: [0]})
+    rep = eng.serve(src, max_ticks=500)
+    assert len(rep.results) == 6
+    assert all(r.outcome in (OUTCOME_CANCELLED, OUTCOME_EOS,
+                             OUTCOME_BUDGET) for r in rep.results)
+    assert rep.outcomes.get(OUTCOME_CANCELLED, 0) >= 1
+    assert not eng._tree_fams and not eng._families
+    assert_drained(eng)
+
+
+def test_tree_property_random_families_drain_clean(params):
+    """Leak gate: random tree-shaped families (n up to 6 on 2 slots —
+    fork could not even admit those), fork_at conversions, and
+    cancels, all interleaved, drain to zero."""
+    eng = tree_eng(params)
+    prng = np.random.default_rng(777)
+    arrivals, cancels = [], {}
+    uid, tick = 0, 0
+    for _ in range(60):
+        r = prng.random()
+        tick += int(prng.integers(0, 3))
+        if r < 0.6 or uid == 0:
+            kw = {}
+            style = prng.random()
+            if style < 0.45:
+                kw["n"] = int(prng.integers(2, 7))
+            elif style < 0.6:
+                kw["best_of"] = int(prng.integers(2, 5))
+            elif style < 0.75:
+                kw["fork_at"] = int(prng.integers(1, 3))
+            arrivals.append(_req(
+                uid,
+                prng.integers(0, 128, size=int(prng.integers(2, 8)))
+                .astype(np.int32),
+                n_new=int(prng.integers(2, 5)),
+                arrival_tick=tick, seed=int(prng.integers(0, 99)),
+                **kw,
+            ))
+            uid += 1
+        else:
+            cancels.setdefault(tick, []).append(
+                int(prng.integers(0, uid + 2))
+            )
+    rep = eng.serve(ScriptedSource(eng, arrivals, cancels),
+                    max_ticks=40_000)
+    assert sorted(set(r.uid for r in rep.results)) == list(range(uid))
+    assert not eng._tree_fams and not eng._families
+    assert_drained(eng)
+
+
+# ---------------------------------------------------------------------------
+# (d) stochastic speculative acceptance
+# ---------------------------------------------------------------------------
+
+# The prompt-lookup drafter only fires when the decoded suffix loops;
+# a sampled stream rarely does, so the spec tests draft with the model
+# itself — proposals are guaranteed, acceptance is the variable.
+_REP_PROMPT = np.asarray([5, 6, 7, 8] * 4, np.int32)
+
+
+def _spec_engine(params):
+    from tree_attention_tpu.serving.speculation import DraftModelDrafter
+
+    key = "spec-model"
+    if key not in _ENGINES:
+        _ENGINES[key] = SlotServer(
+            params, CFG, slots=2, speculate=True, draft_k=3,
+            drafter=DraftModelDrafter(params, CFG), **BASE_KW,
+        )
+    return _ENGINES[key]
+
+
+def test_spec_sampled_matches_nonspec_stream(params):
+    """The coupling contract: spec-on temperature-0.8 decode emits the
+    SAME tokens as the non-spec sampled stream for the same seed —
+    acceptance only changes how many ticks it takes, never the
+    distribution (here: never the realized draw)."""
+    spec = _spec_engine(params)
+    plain = engine(params, slots=2)
+    req = lambda u: [_req(u, _REP_PROMPT, n_new=6, temperature=0.8,
+                          seed=17)]
+    s = spec.serve(req(0))
+    assert s.spec["proposed"] > 0, s.spec  # drafts actually flowed
+    p = plain.serve(req(0))
+    assert s.results[0].tokens == p.results[0].tokens, (
+        s.results[0].tokens, p.results[0].tokens,
+    )
+    assert np.isclose(s.results[0].cum_logprob,
+                      p.results[0].cum_logprob, rtol=1e-4, atol=1e-5)
+    # Bit-reproducible across a re-serve.
+    s2 = spec.serve(req(0))
+    assert s2.results[0].tokens == s.results[0].tokens
+    assert_drained(spec)
+    assert_drained(plain)
+
+
+def test_spec_greedy_path_unchanged(params):
+    """temperature=0 under speculation still rides the deterministic
+    longest-prefix accept — and matches the plain greedy stream. The
+    model drafting for itself must accept EVERYTHING."""
+    spec = _spec_engine(params)
+    plain = engine(params, slots=2)
+    s = spec.serve([_req(1, _REP_PROMPT, n_new=6)])
+    p = plain.serve([_req(1, _REP_PROMPT, n_new=6)])
+    assert s.results[0].tokens == p.results[0].tokens
+    assert s.spec["proposed"] > 0
+    assert s.spec["acceptance_rate"] == 1.0, s.spec
+    assert_drained(spec)
+
+
+# ---------------------------------------------------------------------------
+# (e) surfaces: conversion, best-of, telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_fork_at_converts_live_slot_to_tree(params):
+    eng = tree_eng(params)
+    rep = eng.serve([_req(0, _prompt(39), n_new=8, fork_at=3, seed=6)])
+    res = _branches(rep)
+    assert sorted(res) == [0, 1]
+    assert res[0][:3] == res[1][:3], "conversion lost the prefix"
+    assert res[0] != res[1], "converted branches never diverged"
+    assert rep.kv["tree_families"] == 1
+    assert rep.kv["forks"] == 1  # the fork ledger still counts it
+    assert_drained(eng)
+
+
+def test_tree_best_of_streams_only_the_winner(params):
+    eng = tree_eng(params)
+    got = {"tok": [], "fin": []}
+    rep = eng.serve([_req(
+        0, _prompt(40, n=8), n_new=4, best_of=4, seed=8,
+        on_branch_token=lambda i, t: got["tok"].append((i, t)),
+        on_branch_finish=lambda i, r: got["fin"].append((i, r)),
+    )])
+    assert rep.kv["tree_families"] == 1
+    assert len(rep.results) == 4
+    assert len(got["fin"]) == 1 and got["fin"][0][0] == 0
+    winner = got["fin"][0][1]
+    best = max(rep.results, key=lambda r: (r.cum_logprob, -r.index))
+    assert winner.tokens == best.tokens
+    assert [t for _, t in got["tok"]] == winner.tokens
+    assert all(i == 0 for i, _ in got["tok"])
+    assert_drained(eng)
+
+
+def test_tree_telemetry_gauge_flight_and_accept_counter(params):
+    from tree_attention_tpu import obs
+    from tree_attention_tpu.obs.flight import FLIGHT
+
+    tree = tree_eng(params)
+    spec = _spec_engine(params)
+    obs.enable()
+    FLIGHT.clear()
+    FLIGHT.arm()
+    try:
+        reg = obs.REGISTRY
+        samples0 = reg.counter(
+            "serving_spec_accept_samples_total").value()
+        tree.serve([_req(0, _prompt(41, n=8), n_new=4, n=5, seed=3)])
+        recs = FLIGHT.snapshot()["records"]
+        assert {"tree_branches", "branch_retired"} <= set(recs[0])
+        # Every decode tick replays all live branches; every branch
+        # retires exactly once.
+        assert max(r["tree_branches"] for r in recs) == 5
+        assert sum(r["branch_retired"] for r in recs) == 5
+        assert reg.gauge("serving_tree_branches").value() == 0.0
+        # The stochastic accept path counts its ratio-test samples.
+        spec.serve([_req(1, _REP_PROMPT, n_new=6, temperature=0.8,
+                         seed=2)])
+        assert reg.counter(
+            "serving_spec_accept_samples_total"
+        ).value() > samples0
+    finally:
+        obs.disable()
+        FLIGHT.disarm()
+        FLIGHT.clear()
+    assert_drained(tree)
+    assert_drained(spec)

@@ -163,6 +163,17 @@ _IGNORE_KEYS = frozenset((
     # (standard rules), and merge_collectives_count (exact, pinned 3).
     "shards", "blocks_per_device", "kv_block",
     "max_new_tokens_streamed",
+    # Token-tree sibling record (ISSUE 20): per-arm peak-block and
+    # pool-byte echoes are deterministic ledger math at a fixed config
+    # (the guarded metric is their ratio: pool_bytes_ratio,
+    # smaller-better, listed above) and the family/drafter shape counts
+    # are workload echoes — the other guarded metrics of the family are
+    # max_concurrent_improvement / tokens_per_sec_ratio /
+    # acceptance_rate (larger-better) and ttft_p50_ratio
+    # (smaller-better), all via the standard rules.
+    "peak_blocks_tree", "peak_blocks_fork",
+    "pool_bytes_tree", "pool_bytes_fork", "families", "temperature",
+    "proposed", "draft_k",
 ))
 
 

@@ -121,8 +121,17 @@ class Source:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
+        # Context/operator token nodes (Load/Store/Add/IsNot/...) are
+        # parser-shared SINGLETONS: stamping a parent on one aims it at
+        # the module's LAST user, and any deepcopy that follows the
+        # pointer (mirror-drift's region copies) drags an arbitrary
+        # module-sized chain with it. Their parent is meaningless — skip.
+        _tokens = (ast.expr_context, ast.boolop, ast.operator,
+                   ast.unaryop, ast.cmpop)
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
+                if isinstance(child, _tokens):
+                    continue
                 child._lint_parent = parent  # type: ignore[attr-defined]
         # line -> list of (rule, reason). Regex over raw lines: a string
         # literal containing the marker would false-match, but the marker

@@ -323,11 +323,38 @@ def _check_tree_bits(src: Source, findings: List[Finding]) -> None:
                  f"silently truncate visibility")
 
 
+def _check_sibling_packer(src: Source, findings: List[Finding]) -> None:
+    """The sibling-row packer (ISSUE 20) feeds the same int32 tree
+    bitmasks: a ``*pack_siblings*`` function must itself carry the
+    ``rows <= 32`` limit check — its bundles reach the kernels through
+    the engine's generic tree-mask operands, so the packer is the last
+    guard before silently truncated visibility."""
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if "pack_siblings" not in fn.name:
+            continue
+        has_limit = any(
+            isinstance(node, ast.Compare) and any(
+                isinstance(c, ast.Constant) and c.value == 32
+                for c in ast.walk(node)
+            )
+            for node in ast.walk(fn)
+        )
+        if not has_limit:
+            emit(findings, src, RULE, fn,
+                 f"'{fn.name}' packs sibling rows for the int32 tree "
+                 f"bitmasks without a rows <= 32 limit check — wider "
+                 f"bundles silently truncate visibility")
+
+
 @lint_pass(RULE)
 def check(src: Source) -> List[Finding]:
-    if not _in_scope(src.path):
-        return []
     findings: List[Finding] = []
+    if src.path.endswith("serving/speculation.py"):
+        _check_sibling_packer(src, findings)
+    if not _in_scope(src.path):
+        return findings
     _check_index_maps(src, findings)
     _check_scalar_prefetch(src, findings)
     _check_tree_bits(src, findings)

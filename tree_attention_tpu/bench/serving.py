@@ -1363,6 +1363,248 @@ def bench_serving_forked_sampling(
     }
 
 
+def bench_serving_tree_sampling(
+    *,
+    slots: int = 8,
+    branches: int = 8,
+    prompt_len: int = 48,
+    max_new: int = 5,
+    kv_block: int = 16,
+    n_requests: int = 4,
+    repeats: int = 3,
+    cfg: Optional[TransformerConfig] = None,
+    seed: int = 13,
+) -> Dict[str, Any]:
+    """The token-tree sibling decode record (ISSUE 20): n>1 sampling as
+    ONE tree-masked row bundle in ONE slot vs the PR-15 fork-slot path,
+    at EQUAL pool bytes (identical engine shapes; only ``tree_sampling``
+    differs).
+
+    Three measurements, parity first:
+
+    - **Parity** — a seeded temperature-1 ``n = branches`` family on the
+      tree arm vs the SAME request on the fork arm, asserted
+      token-identical per branch BEFORE any number is reported (both
+      paths draw from the same ``fold_in(request_key, branch, index)``
+      chain, so this is a pure packing/attention equivalence gate); and
+      the tree family served twice, asserted bit-identical.
+    - **Family economics** — one ``n = branches`` family's
+      ``peak_blocks_used`` tree vs fork (``pool_bytes_ratio`` must be
+      <= 1.0: the tree replays suffix rows instead of materializing
+      per-branch tail blocks) and the family's slot footprint: ONE slot
+      on the tree arm vs ``branches`` on the fork arm, read from the
+      burst trace's ``max_concurrent_requests``.
+    - **Burst trace** — ``n_requests`` families all queued at start on
+      both arms at the same slot count and pool: the fork arm serializes
+      (each family takes all ``branches`` slots), the tree arm runs one
+      family per slot — ``max_concurrent_improvement``, tokens/sec
+      ratio, and per-branch TTFT p50 ratio are the headline.
+
+    Plus the **stochastic-acceptance distribution gate**: spec-on
+    temperature-0.8 decode (Leviathan ratio test under deterministic
+    stream keys, arXiv:2211.17192) asserted token-identical to the
+    non-speculative sampled stream for the same seed — the point-mass
+    coupling makes the distribution claim checkable as bit equality —
+    and bit-reproducible across serves.
+
+    CPU proxy by design: the slot/pool economics are ledger math and
+    transfer exactly; absolute tokens/sec does not.
+    """
+    cache_len = prompt_len + branches * (max_new - 1) + kv_block
+    cfg = cfg or serving_model_config(
+        max_seq_len=cache_len, vocab_size=128, d_model=64
+    )
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    kv_token_bytes = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.d_head
+                      * jnp.dtype(cfg.dtype).itemsize)
+    block_bytes = kv_block * kv_token_bytes
+
+    def build(tree: bool, **kw) -> SlotServer:
+        return SlotServer(
+            params, cfg, slots=slots, cache_len=cache_len,
+            kv_block=kv_block, temperature=1.0, seed=seed,
+            tree_sampling=tree, **kw,
+        )
+
+    rng = np.random.default_rng(seed + 1)
+    prompt = rng.integers(0, cfg.vocab_size,
+                          size=prompt_len).astype(np.int32)
+
+    def fam_req(uid: int) -> Request:
+        return Request(uid=uid, prompt=prompt, max_new_tokens=max_new,
+                       n=branches, seed=seed + 5)
+
+    # --- parity gates -----------------------------------------------------
+    with obs.span("bench_serving_tree:parity", cat="bench"):
+        tree_eng = build(True)
+        fork_eng = build(False)
+        t1 = tree_eng.serve([fam_req(0)])
+        assert t1.kv.get("tree_families", 0) == 1, (
+            f"PARITY VIOLATION: tree path did not engage: {t1.kv}"
+        )
+        f1 = fork_eng.serve([fam_req(0)])
+        got_t = {r.index: r.tokens for r in t1.results}
+        got_f = {r.index: r.tokens for r in f1.results}
+        for j in range(branches):
+            assert got_t[j] == got_f[j], (
+                f"PARITY VIOLATION: tree branch {j} diverged from the "
+                f"fork-slot path"
+            )
+        t2 = tree_eng.serve([fam_req(0)])
+        assert {r.index: r.tokens for r in t2.results} == got_t, (
+            "PARITY VIOLATION: tree family not reproducible across "
+            "serves"
+        )
+        leak = tree_eng.leak_report()
+        assert leak["blocks_used"] == leak["blocks_cached"] \
+            and leak["blocks_shared"] == 0 \
+            and leak["blocks_reserved"] == 0, leak
+
+    # --- family economics (one request, exact ledger math) ---------------
+    with obs.span("bench_serving_tree:family", cat="bench"):
+        peak_tree = t1.kv["peak_blocks_used"]
+        peak_fork = f1.kv["peak_blocks_used"]
+        family_rec = {
+            "branches": branches,
+            "kv_block": kv_block,
+            "peak_blocks_tree": peak_tree,
+            "peak_blocks_fork": peak_fork,
+            "pool_bytes_tree": peak_tree * block_bytes,
+            "pool_bytes_fork": peak_fork * block_bytes,
+            "pool_bytes_ratio": round(peak_tree / max(peak_fork, 1), 3),
+        }
+        assert family_rec["pool_bytes_ratio"] <= 1.0, (
+            f"tree family peaked at {family_rec['pool_bytes_ratio']}x "
+            f"the fork-slot pool bytes (claim: the shared-ancestor "
+            f"bundle never exceeds per-branch CoW tails)"
+        )
+
+    # --- burst trace: capacity + throughput at equal pool bytes ----------
+    def burst() -> List[Request]:
+        return [
+            Request(uid=10 + j, prompt=prompt, max_new_tokens=max_new,
+                    n=branches, seed=seed + 6 + j)
+            for j in range(n_requests)
+        ]
+
+    def run_arm(server: SlotServer) -> Dict[str, Any]:
+        server.serve(burst())  # compile + warm
+        runs = []
+        for _ in range(repeats):
+            report = server.serve(burst())
+            d = report.as_dict()
+            d["max_concurrent_requests"] = _max_concurrent(report)
+            ttfts = sorted(r.ttft_s for r in report.results if r.tokens)
+            d["branch_ttft_p50_s"] = (
+                ttfts[len(ttfts) // 2] if ttfts else 0.0
+            )
+            runs.append(d)
+        return {
+            "tokens_per_sec": max(r["tokens_per_sec"] for r in runs),
+            "branch_ttft_p50_s": min(
+                r["branch_ttft_p50_s"] for r in runs
+            ),
+            "max_concurrent_requests": max(
+                r["max_concurrent_requests"] for r in runs
+            ),
+        }
+
+    with obs.span("bench_serving_tree:trace", cat="bench"):
+        trace_rec = {
+            "families": n_requests,
+            "tree": run_arm(tree_eng),
+            "fork": run_arm(fork_eng),
+        }
+        cc_fork = trace_rec["fork"]["max_concurrent_requests"]
+        trace_rec["max_concurrent_improvement"] = round(
+            trace_rec["tree"]["max_concurrent_requests"]
+            / max(cc_fork, 1), 2
+        )
+        tps_fork = trace_rec["fork"]["tokens_per_sec"]
+        if tps_fork > 0:
+            trace_rec["tokens_per_sec_ratio"] = round(
+                trace_rec["tree"]["tokens_per_sec"] / tps_fork, 3
+            )
+        p50_fork = trace_rec["fork"]["branch_ttft_p50_s"]
+        if p50_fork > 0:
+            trace_rec["ttft_p50_ratio"] = round(
+                trace_rec["tree"]["branch_ttft_p50_s"] / p50_fork, 3
+            )
+        assert trace_rec["max_concurrent_improvement"] >= 1.0, (
+            "tree families should never be LESS concurrent than "
+            "fork-slot families at equal pool bytes"
+        )
+
+    # --- stochastic acceptance: the distribution gate ---------------------
+    with obs.span("bench_serving_tree:stochastic", cat="bench"):
+        from tree_attention_tpu.serving.speculation import (
+            DraftModelDrafter,
+        )
+
+        # The model drafts for itself: proposals are guaranteed every
+        # tick, so the ratio test actually runs (prompt-lookup only
+        # fires when a sampled stream happens to loop).
+        rep_prompt = np.tile(np.array([5, 6, 7, 8], np.int32), 4)
+        spec = SlotServer(
+            params, cfg, slots=2, cache_len=cache_len,
+            kv_block=kv_block, speculate=True, draft_k=3, seed=seed,
+            drafter=DraftModelDrafter(params, cfg),
+        )
+        plain = SlotServer(
+            params, cfg, slots=2, cache_len=cache_len,
+            kv_block=kv_block, seed=seed,
+        )
+        sreq = [Request(uid=0, prompt=rep_prompt, max_new_tokens=8,
+                        temperature=0.8, seed=seed + 9)]
+        s1 = spec.serve(sreq)
+        p1 = plain.serve(sreq)
+        assert s1.spec["proposed"] > 0, s1.spec
+        assert s1.results[0].tokens == p1.results[0].tokens, (
+            "DISTRIBUTION VIOLATION: spec-on temperature-0.8 stream "
+            "diverged from the non-speculative sampled stream (the "
+            "point-mass coupling must make them bit-equal)"
+        )
+        s2 = spec.serve(sreq)
+        assert s2.results[0].tokens == s1.results[0].tokens, (
+            "spec-on sampled stream not reproducible across serves"
+        )
+        stochastic_rec = {
+            "temperature": 0.8,
+            "proposed": s1.spec["proposed"],
+            "accepted": s1.spec["accepted"],
+            "acceptance_rate": s1.spec["acceptance_rate"],
+            "distribution_gate": "bit-equal to non-spec sampled stream",
+        }
+
+    log.info(
+        "tree sampling: n=%d in ONE slot at %.2fx fork pool bytes, "
+        "max concurrent %.1fx, branch ttft p50 ratio %s, spec-on "
+        "accept rate %.2f",
+        branches, family_rec["pool_bytes_ratio"],
+        trace_rec["max_concurrent_improvement"],
+        trace_rec.get("ttft_p50_ratio"),
+        stochastic_rec["acceptance_rate"],
+    )
+    return {
+        "workload": {
+            "model": {
+                "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                "heads": cfg.n_heads, "kv_heads": cfg.n_kv_heads,
+                "vocab": cfg.vocab_size, "dtype": str(cfg.dtype),
+            },
+            "slots": slots,
+            "cache_len": cache_len,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new,
+            "branches": branches,
+        },
+        "parity": "token-identical to fork slots + bit-reproducible",
+        "family": family_rec,
+        "trace": trace_rec,
+        "stochastic": stochastic_rec,
+    }
+
+
 # ---------------------------------------------------------------------------
 # ISSUE 10: trace replay + chaos harness against the live HTTP ingress
 # ---------------------------------------------------------------------------

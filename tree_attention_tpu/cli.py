@@ -519,11 +519,9 @@ def _run_serve(cfg: RunConfig, mesh) -> int:
             )
     if cfg.default_deadline is not None and cfg.default_deadline <= 0:
         raise SystemExit("--default-deadline must be > 0 seconds")
-    if cfg.speculate and cfg.temperature != 0.0:
-        raise SystemExit(
-            "--speculate requires greedy decoding: pass --temperature 0 "
-            "(the greedy accept rule is what makes speculation exact)"
-        )
+    # --speculate composes with sampling (ISSUE 20): temperature > 0
+    # runs the stochastic (Leviathan) accept walk, which emits the
+    # target distribution exactly — no greedy restriction.
     if cfg.top_k < 0:
         raise SystemExit("--top-k must be >= 0 (0 = off)")
     if cfg.temperature < 0:

@@ -1433,6 +1433,74 @@ def sample_slots(
     return tok, lp
 
 
+def sample_rows(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    row_keys: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-ROW sampling over a verify-shaped logits bundle (ISSUE 20):
+    the row-wise generalization of :func:`sample_slots` for the
+    ``(S, Tq, V)`` output of a tree/verify tick. Row ``(i, j)`` samples
+    under ``row_keys[i, j]`` — the caller derives each row's key from
+    the reproducibility chain (request key, branch index, produced
+    stream index), so the key is already final: no index is folded in
+    here. Temperature-0 slots take the exact per-row argmax, which is
+    bit-identical to the greedy verify path this generalizes.
+
+    Two consumers share this one function:
+
+    - **token-tree sibling decode**: each live branch's deepest row is
+      that branch's next sampled token;
+    - **stochastic speculative acceptance** (Leviathan et al.,
+      arXiv:2211.17192): row ``j``'s sample is the target-model draw
+      after the path ending at row ``j`` — accepting a point-mass draft
+      iff the draw equals it IS the ratio test, so the committed stream
+      is distributed (and, under fixed keys, bit-) identical to
+      non-speculative sampling.
+
+    Args:
+      logits: ``(S, Tq, V)`` verify-tick logits.
+      temperature: ``(S,)`` float32 per-slot temperature (0 = greedy).
+      top_k: ``(S,)`` int32 per-slot top-k cutoff (0 = off).
+      row_keys: ``(S, Tq, 2)`` uint32 per-row PRNG keys (pre-folded).
+
+    Returns:
+      ``(tok, logprob)``: ``(S, Tq)`` int32 sampled ids and ``(S, Tq)``
+      float32 UNadjusted model log-probabilities of the chosen tokens.
+    """
+    V = logits.shape[-1]
+    lf = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+
+    def one(lg, t, k, key):
+        # Same distribution as sample_slots' inner draw: dynamic top-k
+        # threshold (ties keep >=), temperature-scaled categorical.
+        srt = jnp.sort(lg)
+        kk = jnp.clip(k, 1, V)
+        thresh = srt[V - kk]
+        masked = jnp.where((k > 0) & (lg < thresh), -jnp.inf, lg)
+        t_safe = jnp.where(t > 0, t, 1.0)
+        return jax.random.categorical(key, masked / t_safe)
+
+    def rows(lg, t, k, keys):  # (Tq, V) -> (Tq,)
+        return jax.vmap(lambda g, kk: one(g, t, k, kk))(lg, keys)
+
+    # All-greedy ticks (temperature 0 everywhere — the spec default)
+    # pay the argmax alone, exactly like the pre-sampling verify path.
+    sampled = lax.cond(
+        jnp.any(temperature > 0.0),
+        lambda _: jax.vmap(rows)(lf, temperature, top_k,
+                                 row_keys).astype(jnp.int32),
+        lambda _: greedy,
+        operand=None,
+    )
+    tok = jnp.where(temperature[:, None] > 0.0, sampled, greedy)
+    logp = jax.nn.log_softmax(lf, axis=-1)
+    lp = jnp.take_along_axis(logp, tok[..., None], axis=-1)[..., 0]
+    return tok, lp
+
+
 def generate(
     params: Params,
     prompt: jax.Array,

@@ -325,6 +325,11 @@ class DisaggServer:
         # validation; mid-generation fork(uid) still works, applied on
         # the decode worker (live slots only exist there).
         self.prefill._fork_ok = False
+        # Token-tree sibling decode (ISSUE 20) is a fused-engine feature:
+        # the decode worker's tick loop serves chain verify rows only, so
+        # a mid-generation fork must take the sibling-slot path, never the
+        # in-slot tree conversion.
+        self.decode._tree_sampling = False
         # Thread-safe control mailboxes — the ingress's seams. RLock: the
         # drain flag is flipped from SIGTERM handlers (the ingress's
         # install_drain_signals contract), which may interrupt a handler
@@ -998,6 +1003,19 @@ class DisaggServer:
                         reset_val = np.zeros((dc.slots,), np.int32)
                         emit = np.zeros((dc.slots,), bool)
                         use_dev0 = np.zeros((dc.slots,), bool)
+                        # Per-ROW key-chain operands (ISSUE 20): decode-
+                        # worker verify rows always ride the slot's own
+                        # spec chain (branch < 0), stream index = emitted
+                        # count + row depth — same fill as the fused
+                        # engine's spec tick.
+                        sidx = np.asarray(
+                            [len(t) for t in dc._slot_tokens], np.int32
+                        )
+                        branch_m = np.full((dc.slots, tq), -1, np.int32)
+                        ridx_m = sidx[:, None] + np.tile(
+                            np.arange(tq, dtype=np.int32),
+                            (dc.slots, 1),
+                        )
                         need_tree = False
                         for i, pack in spec_plan.items():
                             r = pack.rows
@@ -1008,6 +1026,7 @@ class DisaggServer:
                             # adoption length fix (clen == plen there).
                             reset[i] = True
                             reset_val[i] = dc._slot_clen[i]
+                            ridx_m[i, :r] = sidx[i] + pack.depth
                             if not np.array_equal(
                                 pack.depth, np.arange(r, dtype=np.int32)
                             ):
@@ -1023,6 +1042,13 @@ class DisaggServer:
                             jnp.asarray(reset), jnp.asarray(reset_val),
                             jnp.asarray(emit),
                         )
+                        extra = (
+                            dc._keys, jnp.asarray(dc._temp_np),
+                            jnp.asarray(dc._topk_np),
+                            jnp.asarray(sidx), dc._lp,
+                            jnp.asarray(dc._salt_np),
+                            jnp.asarray(branch_m), jnp.asarray(ridx_m),
+                        )
                         if need_tree:
                             depth_m = np.tile(
                                 np.arange(tq, dtype=np.int32),
@@ -1036,24 +1062,33 @@ class DisaggServer:
                                 r = pack.rows
                                 depth_m[i, :r] = pack.depth
                                 bits_m[i, :r, :r] = pack.anc
-                            fused_dev, dc.cache = dc._spec_tree(
-                                *args, jnp.asarray(depth_m),
-                                jnp.asarray(bits_m), dc.cache,
-                            )
+                            dc.tok, dc._lp, fused_dev, _, dc.cache = \
+                                dc._spec_tree(
+                                    *args, jnp.asarray(depth_m),
+                                    jnp.asarray(bits_m), dc.cache,
+                                    *extra,
+                                )
                         else:
-                            fused_dev, dc.cache = dc._spec_lin(
-                                *args, dc.cache
-                            )
-                        dc.tok = fused_dev[:, 0]
-                        # lint: allow[host-sync] the decode worker's one per-tick fetch (fused token vector + verify argmaxes)
+                            dc.tok, dc._lp, fused_dev, _, dc.cache = \
+                                dc._spec_lin(
+                                    *args, dc.cache, *extra,
+                                )
+                        # lint: allow[host-sync] the decode worker's one per-tick fetch (fused token/logprob vectors + every verify-row draw)
                         fused_host = np.asarray(fused_dev)
-                        dc._tok_host = fused_host[:, 0]
+                        dc._tok_host = fused_host[:, 0, 0]
+                        dc._lp_host = np.ascontiguousarray(
+                            fused_host[:, 0, 1]
+                        ).view(np.float32)
+                        alltok_host = fused_host[:, 1:, 0]
+                        alllp_host = np.ascontiguousarray(
+                            fused_host[:, 1:, 1]
+                        ).view(np.float32)
                         now2 = time.monotonic()
                         decode_ticks += 1
                         occupancy += len(live_idx)
                         n_new = dc._spec_commit_all(
-                            spec_plan, fused_host[:, 1:], tq, now2, tick,
-                            results, tbt,
+                            spec_plan, alltok_host, alllp_host, tq, now2,
+                            tick, results, tbt,
                         )
                         tokens += n_new
                         tokens_this_tick += n_new

@@ -156,6 +156,7 @@ from tree_attention_tpu.models.decode import (
     paged_insert_slot,
     quantize_cache,
     quantize_paged_blocks,
+    sample_rows,
     sample_slots,
     scatter_kv_blocks,
 )
@@ -170,8 +171,10 @@ from tree_attention_tpu.serving.speculation import (
     DraftProposal,
     PackedSpec,
     accept_longest_path,
+    accept_stochastic_path,
     make_drafter,
     pack_proposal,
+    pack_siblings,
 )
 from tree_attention_tpu.models.transformer import Params, TransformerConfig
 from tree_attention_tpu.utils.logging import get_logger
@@ -235,6 +238,17 @@ _FORK_SHARED = obs.counter(
     "serving_fork_blocks_shared_total",
     "full ancestor KV blocks a fork SHARED (radix pins + refcounted "
     "CoW blocks) instead of copying or recomputing them",
+)
+_TREE_BRANCHES = obs.gauge(
+    "serving_tree_branches",
+    "live sibling branches decoding as token trees in single slots "
+    "(set once per tick; 0 when no tree family is in flight)",
+)
+_SPEC_ACCEPT_SAMPLES = obs.counter(
+    "serving_spec_accept_samples_total",
+    "per-row stochastic draws consumed by sampled (temperature > 0) "
+    "speculative accept walks — the Leviathan ratio test's coupled "
+    "samples; greedy verifies draw nothing and do not count",
 )
 
 
@@ -341,8 +355,10 @@ class RequestResult:
     # per branch, all under the family's one uid.
     index: int = 0
     # Sum of the model log-probabilities of this branch's sampled tokens
-    # — best-of-n's server-side selection key (0.0 under speculation,
-    # which is greedy-only and tracks no logprobs).
+    # — best-of-n's server-side selection key. Speculative serving tracks
+    # it too (ISSUE 20): each verify row's fused output carries the
+    # draw's logprob, so accepted bursts accumulate bit-identically to
+    # the non-speculative stream.
     cum_logprob: float = 0.0
     # Finished request-cost ledger (ISSUE 16): the dict
     # ``obs.REQLOG.finish`` returned at retire — wall segments, token
@@ -377,6 +393,23 @@ class _ForkFamily:
     branches: int
     forked: bool = False
     done: List[RequestResult] = dataclasses.field(default_factory=list)
+    # Token-tree sibling decode (ISSUE 20): the family's k branches
+    # share ONE slot, replaying their divergent suffixes as one
+    # verify-shaped row bundle per tick under tree_mask/positions. The
+    # device cache is frozen at ``base_len`` committed rows (the shared
+    # ancestor path); each live branch's tokens past ``fork_len - 1``
+    # are its private suffix, re-verified every tick. Branch b's j-th
+    # token samples under fold_in(fold_in(fold_in(base, salt), b),
+    # fork_len + depth) — the fork-slot path's exact key chain, so the
+    # two layouts are token-identical under one seed.
+    tree: bool = False
+    base_len: int = 0      # frozen committed length (shared ancestors)
+    fork_len: int = 0      # emitted tokens shared by all branches + 1
+    br_tokens: List[List[int]] = dataclasses.field(default_factory=list)
+    br_cum_lp: List[float] = dataclasses.field(default_factory=list)
+    br_live: List[bool] = dataclasses.field(default_factory=list)
+    br_index: List[int] = dataclasses.field(default_factory=list)
+    br_ttft: List[float] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -771,6 +804,7 @@ class SlotServer:
         block_pool: Optional[BlockAllocator] = None,
         prefix_index: Optional[Any] = None,
         host_blocks: int = 0,
+        tree_sampling: bool = True,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -829,14 +863,14 @@ class SlotServer:
             raise ValueError("top_k must be >= 0 (0 = off)")
         self._speculate = bool(speculate)
         if self._speculate:
-            if self.temperature != 0.0:
-                # The greedy accept rule is exact; sampled acceptance
-                # (rejection sampling over distributions) is a different
-                # contract this engine does not implement.
-                raise ValueError(
-                    "speculate=True requires greedy decoding "
-                    "(temperature=0)"
-                )
+            # Sampled acceptance (temperature > 0) runs the Leviathan
+            # ratio test (arXiv:2211.17192) specialised to point-mass
+            # drafts: each verify row draws from the model's own
+            # distribution under the request's fold_in(key, j) stream
+            # key and accepts the draft iff the draw reproduces it —
+            # distribution-exact AND token-identical to the non-spec
+            # sampled path under the same seed. Temperature 0 keeps the
+            # legacy greedy accept rule bit-for-bit (ISSUE 20).
             if not 1 <= draft_k <= 31:
                 raise ValueError(
                     f"draft_k must be in [1, 31] (int32 tree bitmasks), "
@@ -861,6 +895,11 @@ class SlotServer:
         self._lp_host = np.zeros((slots,), np.float32)
         self._temp_np = np.zeros((slots,), np.float32)
         self._topk_np = np.zeros((slots,), np.int32)
+        # Host mirror of each slot's PRNG salt (seed-or-uid): tree
+        # sibling rows re-derive the full fold chain IN-PROGRAM from
+        # (salt, branch, stream index) operands, so the verify step
+        # needs the raw salt, not just the installed per-slot key.
+        self._salt_np = np.zeros((slots,), np.int32)
         self._slot_index = [0] * slots
         self._slot_cum_lp = [0.0] * slots
         self._seed_key = jax.jit(self._seed_key_fn, donate_argnums=(0,))
@@ -871,6 +910,15 @@ class SlotServer:
         # fork(uid) mailbox's deferral carry, and per-tick flight
         # counters.
         self._families: Dict[int, _ForkFamily] = {}
+        # Token-tree sibling families by SLOT (ISSUE 20): the n>1 /
+        # best-of families whose branches decode as one packed token
+        # tree in a single slot instead of n forked slots. Every fam
+        # here is also in _families (the join/best-of machinery is
+        # shared); the per-tick counters feed the flight recorder.
+        self._tree_fams: Dict[int, _ForkFamily] = {}
+        self._tree_sampling = bool(tree_sampling)
+        self._tick_tree_branches = 0
+        self._tick_branch_retired = 0
         self._slot_shared: List[set] = [set() for _ in range(slots)]
         self._live_reset: Dict[int, int] = {}
         self._fork_uids: List[int] = []
@@ -883,6 +931,9 @@ class SlotServer:
         self._fork_copy = jax.jit(self._fork_copy_fn, donate_argnums=(0,))
         self._sibling_first = jax.jit(self._sibling_first_fn,
                                       donate_argnums=(0, 1))
+        self._tree_first = jax.jit(self._tree_first_fn)
+        self._tree_branches_life = 0
+        self._tree_fams_life = 0
         # Per-slot stash of the prompt-end logits row (device, (V,)) —
         # kept only while the slot's fork family is waiting to expand.
         self._slot_logits: List[Optional[Any]] = [None] * slots
@@ -1257,13 +1308,16 @@ class SlotServer:
                 make_drafter(drafter or "ngram")
                 if isinstance(drafter, str) or drafter is None else drafter
             )
-            self._spec_lin = jax.jit(
-                self._spec_lin_fn, donate_argnums=(8,)
-            )
-            self._spec_tree = jax.jit(
-                self._spec_tree_fn, donate_argnums=(10,)
-            )
-            self._compact = jax.jit(self._compact_fn, donate_argnums=(0,))
+        # The verify-shaped programs serve BOTH speculation and token-
+        # tree sibling decode (ISSUE 20) — jitted unconditionally; an
+        # engine that never runs a verify tick never compiles them.
+        self._spec_lin = jax.jit(
+            self._spec_lin_fn, donate_argnums=(8,)
+        )
+        self._spec_tree = jax.jit(
+            self._spec_tree_fn, donate_argnums=(10,)
+        )
+        self._compact = jax.jit(self._compact_fn, donate_argnums=(0,))
 
     # -- compiled pieces --------------------------------------------------
 
@@ -1399,27 +1453,54 @@ class SlotServer:
                                                  slot, axis=0)
         return tok_vec, lp_vec
 
+    def _tree_first_fn(self, row, branch_ix, salt, temp, topk):
+        """Sample every tree sibling's FIRST token from the parent's
+        stashed prompt-end logits (ISSUE 20): branch ``b`` draws under
+        fold_in(fold_in(fold_in(base, salt), b), 0) — the exact chain
+        :meth:`_sibling_first_fn` evaluates for a fork-slot sibling of
+        the same index, so the two family layouts' first tokens are
+        bit-identical. One tiny dispatch per family start."""
+        n = branch_ix.shape[0]
+        keys = jax.vmap(lambda b: jax.random.fold_in(jax.random.fold_in(
+            self._base_key, salt), b))(branch_ix)
+        rows = jnp.broadcast_to(row, (n, row.shape[-1]))
+        return sample_slots(
+            rows, jnp.full((n,), temp, jnp.float32),
+            jnp.full((n,), topk, jnp.int32), keys,
+            jnp.zeros((n,), jnp.int32),
+        )
+
     def _spec_step(self, params, mat, tok_vec, use_dev0, n_tok, reset,
-                   reset_val, emit, depth, bits, cache):
-        """THE verify-tick program (speculate=True): the same mixed-Tq
-        step as :meth:`_mixed_fn` plus the three speculative extras —
+                   reset_val, emit, depth, bits, cache, keys, temp, topk,
+                   idx, lp_vec, salt, branch_m, ridx_m):
+        """THE verify-tick program (speculate and/or token-tree sibling
+        decode): the same mixed-Tq step as :meth:`_mixed_fn` plus the
+        verify extras —
 
         - row 0 of each slot comes from the DEVICE token vector when
           ``use_dev0`` (a whole-admission ``await`` slot's parked first
           token only exists there); every other row from the host-built
-          matrix (spec mode is greedy, so the host knows every committed
-          token);
-        - ``depth``/``bits`` (tree ticks only): packed draft-tree nodes
-          take RoPE position ``length + depth[row]`` and attend under the
+          matrix (the host knows every committed/replayed token);
+        - ``depth``/``bits`` (tree ticks only): packed tree rows take
+          RoPE position ``length + depth[row]`` and attend under the
           per-slot ancestor mask instead of row-order causal — chain
           slots ride ``arange``/lower-triangular defaults, which are the
           causal rule bit-for-bit;
-        - a second output: the greedy argmax of EVERY row — the accept
-          walk's input (the model's next token after each draft node).
+        - a per-ROW sample of every logits row — the accept walk's
+          input under speculation (greedy rows are pure argmax, exactly
+          the legacy rule; sampled rows draw the Leviathan coupling
+          sample) and the sibling tips under tree decode. Row keys are
+          the reproducibility chain re-derived IN-PROGRAM:
+          ``branch_m[s, r] >= 0`` (a sibling row of branch b at stream
+          index ``ridx_m[s, r]``) folds (salt, branch, index) into the
+          engine's base key — the fork-slot path's exact chain;
+          ``branch_m[s, r] < 0`` (a spec verify row) folds the stream
+          index into the slot's installed request key.
 
-        ``reset_val`` doubles as the rollback: a spec slot always resets
-        to its host-side committed length, which un-counts the rows a
-        previous tick's verify rejected.
+        ``reset_val`` doubles as the rollback: a verify slot always
+        resets to its host-side committed length, which un-counts the
+        rows a previous tick rejected (or a tree slot's replayed
+        suffix).
         """
         tokens = mat.at[:, 0].set(jnp.where(use_dev0, tok_vec, mat[:, 0]))
         length = jnp.where(reset, reset_val, cache.length)
@@ -1433,31 +1514,63 @@ class SlotServer:
         logits, new_cache = forward_step(
             params, tokens, cache, self.cfg, n_tokens=n_tok, **kw
         )
-        idx = jnp.maximum(n_tok - 1, 0)
-        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        # Speculation is greedy-only (enforced at construction), so the
-        # emit sample is a pure argmax — no key, no logprob tracking.
-        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-        nxt = jnp.where(emit, nxt, tokens[:, 0])
-        all_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, Tq)
-        # One fused (S, 1+Tq) output = ONE host fetch per tick: column 0
-        # is the token vector (the awaits/parked contract), the rest the
-        # verify argmax rows.
-        return jnp.concatenate([nxt[:, None], all_tok], axis=1), new_cache
+        row = jnp.maximum(n_tok - 1, 0)
+        last = jnp.take_along_axis(logits, row[:, None, None], axis=1)[:, 0]
+        # Column 0 keeps the mixed-step emit contract verbatim (final
+        # chunks sample their first token under the slot key, parked
+        # tokens/logprobs ride through) — temperature-0 slots reduce to
+        # the legacy greedy argmax bit-for-bit.
+        tok_s, lp_s = self._sample_emit(last, keys, temp, topk, idx)
+        nxt = jnp.where(emit, tok_s, tokens[:, 0])
+        lp_out = jnp.where(emit, lp_s, lp_vec)
+
+        def _row_key(key, s, b, r):
+            tree_k = jax.random.fold_in(jax.random.fold_in(
+                jax.random.fold_in(self._base_key, s), b), r)
+            return jnp.where(b < 0, jax.random.fold_in(key, r), tree_k)
+
+        row_keys = jax.vmap(
+            lambda key, s, bs, rs: jax.vmap(
+                lambda b, r: _row_key(key, s, b, r))(bs, rs)
+        )(keys, salt, branch_m, ridx_m)
+        all_tok, all_lp = sample_rows(logits, temp, topk, row_keys)
+        # One fused (S, 1+Tq, 2) output = ONE host fetch per tick: lane
+        # 0 tokens, lane 1 bitcast logprobs; row 0 the token/logprob
+        # vectors (the awaits/parked contract), the rest the per-row
+        # draws.
+        col0 = jnp.stack(
+            [nxt, lax.bitcast_convert_type(lp_out, jnp.int32)], axis=-1,
+        )[:, None]
+        rest = jnp.stack(
+            [all_tok, lax.bitcast_convert_type(all_lp, jnp.int32)],
+            axis=-1,
+        )
+        fused = jnp.concatenate([col0, rest], axis=1)
+        # ``last`` rides out as a device carry exactly like the mixed
+        # step's: a family admitted on a verify tick still stashes its
+        # prompt-end logits row for the fork/tree start. Fetched never.
+        return nxt, lp_out, fused, last, new_cache
 
     def _spec_lin_fn(self, params, mat, tok_vec, use_dev0, n_tok, reset,
-                     reset_val, emit, cache):
+                     reset_val, emit, cache, keys, temp, topk, idx,
+                     lp_vec, salt, branch_m, ridx_m):
         """Verify tick with chain drafts only — pure causal, no mask or
         position operands (one program family shared with chunk ticks)."""
         return self._spec_step(params, mat, tok_vec, use_dev0, n_tok,
-                               reset, reset_val, emit, None, None, cache)
+                               reset, reset_val, emit, None, None, cache,
+                               keys, temp, topk, idx, lp_vec, salt,
+                               branch_m, ridx_m)
 
     def _spec_tree_fn(self, params, mat, tok_vec, use_dev0, n_tok, reset,
-                      reset_val, emit, depth, bits, cache):
-        """Verify tick with >= 1 token-tree draft: per-slot depths and
-        ancestor masks ride along (SpecInfer, arXiv:2305.09781)."""
+                      reset_val, emit, depth, bits, cache, keys, temp,
+                      topk, idx, lp_vec, salt, branch_m, ridx_m):
+        """Verify tick with >= 1 packed token tree — draft trees
+        (SpecInfer, arXiv:2305.09781) and/or sibling-branch bundles
+        (ISSUE 20): per-slot depths and ancestor masks ride along."""
         return self._spec_step(params, mat, tok_vec, use_dev0, n_tok,
-                               reset, reset_val, emit, depth, bits, cache)
+                               reset, reset_val, emit, depth, bits,
+                               cache, keys, temp, topk, idx, lp_vec,
+                               salt, branch_m, ridx_m)
 
     def _compact_fn(self, cache, start, src, n):
         """Batched commit compaction: move each verifying slot's accepted
@@ -1867,6 +1980,36 @@ class SlotServer:
     # both sides of the handoff).
     _fork_ok = True
 
+    # -- token-tree sibling decode (ISSUE 20) -----------------------------
+
+    def _tree_span(self, req: Request) -> int:
+        """Worst-case token span of an admission tree family: the frozen
+        prompt rows plus every branch's full replayed suffix (each
+        branch grows to ``max_new - 1`` suffix rows before its last
+        token retires it), floored at the plain single-branch span the
+        slot needs after the family collapses to one survivor."""
+        k = self._branches(req)
+        plen = len(req.prompt)
+        return max(plen + k * (req.max_new_tokens - 1),
+                   plen + req.max_new_tokens)
+
+    def _tree_sibling_ok(self, req: Request) -> bool:
+        """Can this n>1 / best-of-n request decode as a token tree in
+        ONE slot? Requires the tree-mask attention path, a paged pool,
+        and the whole family's worst-case row bundle fitting both the
+        verify Tq cap (int32 bitmask: 32 rows) and the cache window.
+        False falls back to the PR-15 fork-slot path — same tokens,
+        k slots."""
+        k = self._branches(req)
+        if k <= 1 or not self._tree_sampling or not self._paged:
+            return False
+        if self._speculate or not self._tree_ok or not self._fork_ok:
+            return False
+        rows = k * (req.max_new_tokens - 1)
+        if rows > self._spec_rows_cap:
+            return False
+        return len(req.prompt) + rows <= self.cache_len
+
     # Admission-scoped host-tier attribution scratch (ISSUE 16): counts
     # accumulated while _admit runs — prefix-path restores by
     # _paged_hit, demote flushes a dry allocator forces mid-admission —
@@ -1886,12 +2029,6 @@ class SlotServer:
             )
         if req.top_k is not None and req.top_k < 0:
             raise ValueError(f"request {req.uid}: top_k must be >= 0")
-        if self._speculate and (req.temperature or 0) > 0:
-            raise ValueError(
-                f"request {req.uid}: sampled decoding (temperature > 0) "
-                f"is not servable by a speculate=True engine (the "
-                f"greedy accept rule is what makes speculation exact)"
-            )
         if req.n < 1:
             raise ValueError(f"request {req.uid}: n must be >= 1")
         if req.best_of is not None and req.best_of > 1 and req.n != 1:
@@ -1921,7 +2058,10 @@ class SlotServer:
                     f"expand fork families; mid-generation fork(uid) "
                     f"on the decode pool still works)"
                 )
-            if branches > self.slots:
+            if branches > self.slots and not self._tree_sibling_ok(req):
+                # Tree-sibling families (ISSUE 20) decode every branch
+                # in ONE slot; only the fork-slot fallback needs a slot
+                # per branch.
                 raise ValueError(
                     f"request {req.uid}: {branches} parallel branches "
                     f"exceed the engine's {self.slots} slots (the whole "
@@ -1955,12 +2095,19 @@ class SlotServer:
                 )
             branches = self._branches(req)
             if branches > 1:
-                # Each sibling's worst case is its NEW blocks only —
-                # everything below the fork point is shared (the CoW
-                # economics this subsystem exists for).
-                fam = need + (branches - 1) * (
-                    need - (plen - 1) // self.kv_block
-                )
+                if self._tree_sibling_ok(req):
+                    # Tree-sibling worst case: the ONE slot's frozen
+                    # ancestor rows plus every branch's packed suffix
+                    # window (never more than the fork-slot family
+                    # below — the suffix rows share every ancestor).
+                    fam = -(-self._tree_span(req) // self.kv_block)
+                else:
+                    # Each sibling's worst case is its NEW blocks only —
+                    # everything below the fork point is shared (the CoW
+                    # economics this subsystem exists for).
+                    fam = need + (branches - 1) * (
+                        need - (plen - 1) // self.kv_block
+                    )
                 if fam > self.kv_blocks:
                     raise ValueError(
                         f"request {req.uid}: a {branches}-branch family "
@@ -1998,12 +2145,18 @@ class SlotServer:
                 np.asarray(req.prompt, np.int32), record=False
             )
         dev_matched = sum(1 for n in nodes if n.tier == TIER_DEVICE)
-        needed = total - dev_matched
         branches = self._branches(req)
         fam_extra = 0
         if branches > 1:
-            sib = total - (len(req.prompt) - 1) // self.kv_block
-            fam_extra = (branches - 1) * sib
+            if self._tree_sibling_ok(req):
+                # Token-tree sibling admission (ISSUE 20): ONE slot
+                # holds the whole family — its reservation is the
+                # packed window's worst case, no per-sibling extra.
+                total = -(-self._tree_span(req) // self.kv_block)
+            else:
+                sib = total - (len(req.prompt) - 1) // self.kv_block
+                fam_extra = (branches - 1) * sib
+        needed = total - dev_matched
         if not self._pool.reserve(needed + fam_extra):
             if nodes:
                 self._prefix.release(nodes)
@@ -2138,6 +2291,7 @@ class SlotServer:
         self._topk_np[slot] = (self.top_k if req.top_k is None
                                else req.top_k)
         salt = (req.seed if req.seed is not None else req.uid) & 0x7FFFFFFF
+        self._salt_np[slot] = salt
         self._keys = self._seed_key(self._keys, jnp.int32(slot),
                                     jnp.int32(salt), jnp.int32(0))
         self.slo.observe_queue_wait(waited)
@@ -2494,18 +2648,27 @@ class SlotServer:
         if self._prefix is not None:
             self._publish_prefix(slot)
 
-    def _plan_chunks(self) -> List[Tuple[int, int, bool]]:
+    def _plan_chunks(
+        self, max_n: Optional[int] = None
+    ) -> List[Tuple[int, int, bool]]:
         """Sarathi-style budget pass: FIFO over prefilling slots, each
         taking up to a chunk, the tick taking at most ``prefill_budget``
-        prompt tokens total. Returns (slot, n, is_final) triples."""
+        prompt tokens total. ``max_n`` clamps the per-slot chunk below
+        the configured size — ticks that carry a token-tree sibling
+        bundle (ISSUE 20) must keep Tq within the int32 tree-bitmask
+        limit, so their chunks shrink to fit. Returns (slot, n,
+        is_final) triples."""
         plan: List[Tuple[int, int, bool]] = []
         budget = self.prefill_budget
+        chunk = self.prefill_chunk
+        if max_n is not None:
+            chunk = min(chunk, max_n)
         for slot in self._prefill_fifo:
             if budget <= 0:
                 break
             plen = len(self._slot_req[slot].prompt)
             pos = self._prefill_pos[slot]
-            n = min(self.prefill_chunk, plen - pos, budget)
+            n = min(chunk, plen - pos, budget)
             if n <= 0:
                 continue
             budget -= n
@@ -2671,6 +2834,7 @@ class SlotServer:
             jnp.int32(child_slot), jnp.int32(tip),
         )
         salt = (req.seed if req.seed is not None else req.uid) & 0x7FFFFFFF
+        self._salt_np[child_slot] = salt
         self._keys = self._seed_key(self._keys, jnp.int32(child_slot),
                                     jnp.int32(salt), jnp.int32(index))
         # Host mirrors: the child is an ordinary live slot from here on.
@@ -2778,6 +2942,22 @@ class SlotServer:
         toks = self._slot_tokens[parent]
         if len(toks) >= req.max_new_tokens:
             return "done"  # retiring this tick; nothing left to branch
+        if parent in self._tree_fams:
+            log.warning(
+                "fork(%d) ignored: the slot already decodes a token "
+                "tree (one conversion per request)", uid,
+            )
+            return "done"
+        t = len(toks)
+        if (self._tree_sampling and self._tree_ok and self._fork_ok
+                and req.uid not in self._families
+                and 2 * (req.max_new_tokens - t) <= self._spec_rows_cap
+                and len(self._prompt_np[parent]) + t - 1
+                + 2 * (req.max_new_tokens - t) <= self.cache_len):
+            # Tree conversion: both continuations share the slot — zero
+            # new slots, zero copied blocks (the partial tail block is
+            # shared too; the tip re-enters as a replayed suffix row).
+            return self._tree_convert_live(parent, uid, tick)
         free = self._free_slots()
         if not free:
             return "retry"
@@ -2835,6 +3015,26 @@ class SlotServer:
         slots, return the family's block hold, and finish each sibling
         unserved with the parent's outcome (one result per requested
         completion, so clients counting n finishes always converge)."""
+        if fam.tree:
+            # Tree families hold no sibling slots and no family hold —
+            # the whole worst case is the parent slot's reservation,
+            # already freed by the retire. Only the per-branch results
+            # need synthesizing.
+            for j in range(1, fam.branches):
+                res = dataclasses.replace(
+                    parent_result, index=j, tokens=[], cum_logprob=0.0,
+                    ttft_s=0.0,
+                )
+                results.append(res)
+                fam.done.append(res)
+                if parent_result.outcome in (OUTCOME_DEADLINE,
+                                             OUTCOME_SHED,
+                                             OUTCOME_ERROR):
+                    self.slo.observe_miss()
+                if obs.REGISTRY.enabled:
+                    _REQUESTS.labels(outcome=res.outcome).inc()
+                self._notify_finish(fam.req, res, fam)
+            return
         if fam.hold:
             self._pool.unreserve(fam.hold)
             fam.hold = 0
@@ -2875,6 +3075,420 @@ class SlotServer:
         for t in winner.tokens:
             self._deliver_token(req, 0, t)
         self._deliver_finish(req, 0, out)
+
+    # -- token-tree sibling decode (ISSUE 20) -----------------------------
+    #
+    # The family's k branches decode in ONE slot as one verify-shaped
+    # row bundle per tick. The device cache freezes at ``base_len``
+    # committed rows (the shared ancestor path — prompt, or prompt +
+    # shared generated prefix for a mid-generation conversion); every
+    # live branch's divergent suffix is REPLAYED into the window
+    # [base_len, base_len + k*s) each tick under tree_mask/positions,
+    # so suffix rows attend only to their own branch plus the frozen
+    # ancestors. Each branch's last row draws its next token under the
+    # fork-slot path's exact key chain — token-identical layouts. A
+    # retiring branch shrinks the window the same tick; the last two
+    # transitions are collapse (k=1: compact the survivor's suffix
+    # contiguous via compact_decode_window and hand the slot back to
+    # the plain decode path) and close (k=0: free the slot).
+
+    def _admit_tree_family(self, req: Request, slot: int) -> None:
+        """Register an n>1 / best-of-n family that will decode as a
+        token tree in ``slot`` (reservation already taken tree-shaped
+        by ``_paged_reserve``). Branches materialize at the awaits
+        pass, the tick the parent's first token lands."""
+        branches = self._branches(req)
+        fam = _ForkFamily(
+            req=req, parent_slot=slot, sibling_slots=[], sib_reserve=0,
+            hold=0, best_of=bool(req.best_of and req.best_of > 1),
+            branches=branches, tree=True,
+            base_len=len(req.prompt), fork_len=1,
+        )
+        self._families[req.uid] = fam
+        self._tree_fams[slot] = fam
+        self._uid_next_index[req.uid] = branches
+        self._tree_fams_life += 1
+
+    def _tree_family_start(self, fam: _ForkFamily, slot: int,
+                           first: int, tick: int, now2: float,
+                           results) -> int:
+        """Branch the freshly-live parent into its k tree siblings —
+        called from the awaits pass BEFORE any EOS check, so even a
+        one-token parent yields k independent samples. Siblings' first
+        tokens draw from the parent's STASHED prompt-end logits under
+        their own branch keys (ONE tiny dispatch + one small fetch per
+        family, not per tick) — bit-identical to the fork-slot path's
+        ``_sibling_first`` draws. Every branch's first token then runs
+        its own EOS/budget check here, branch 0 included (the caller
+        skips its generic check). Returns sibling tokens emitted."""
+        req = fam.req
+        fam.forked = True
+        k = fam.branches
+        row = self._slot_logits[slot]
+        assert row is not None, "tree family lost its logits stash"
+        self._slot_logits[slot] = None
+        bix = np.arange(1, k, dtype=np.int32)
+        tok_d, lp_d = self._tree_first(
+            row, jnp.asarray(bix), jnp.int32(self._salt_np[slot]),
+            jnp.float32(self._temp_np[slot]),
+            jnp.int32(self._topk_np[slot]),
+        )
+        tok_h = np.asarray(tok_d)
+        lp_h = np.asarray(lp_d)
+        fam.br_tokens = [self._slot_tokens[slot]] + [
+            [int(tok_h[j])] for j in range(k - 1)
+        ]
+        fam.br_cum_lp = [self._slot_cum_lp[slot]] + [
+            float(lp_h[j]) for j in range(k - 1)
+        ]
+        fam.br_live = [True] * k
+        fam.br_index = list(range(k))
+        fam.br_ttft = [self._slot_ttft[slot]] * k
+        emitted = 0
+        dead: List[Tuple[int, str]] = []
+        for b in range(k):
+            t0 = int(fam.br_tokens[b][0])
+            if b > 0:
+                self._push_token(req, t0, b)
+                emitted += 1
+                self.slo.observe_ttft(fam.br_ttft[b])
+                if obs.REGISTRY.enabled:
+                    _TOKENS.inc()
+                    _TTFT.observe(fam.br_ttft[b])
+                if obs.TRACER.active:
+                    obs.instant("first_token", cat="serving", args={
+                        "rid": req.uid, "slot": slot, "tick": tick,
+                        "index": b, "tree": True,
+                        "ttft_s": round(fam.br_ttft[b], 6),
+                    })
+            if req.eos_id is not None and t0 == req.eos_id:
+                dead.append((b, OUTCOME_EOS))
+            elif req.max_new_tokens <= 1:
+                dead.append((b, OUTCOME_BUDGET))
+        self._forks_life += k - 1
+        self._tick_forks += k - 1
+        nshare = fam.base_len // self.kv_block
+        self._fork_shared_life += (k - 1) * nshare
+        self._tick_fork_shared += (k - 1) * nshare
+        if obs.REGISTRY.enabled:
+            _FORKS.inc(k - 1)
+            if nshare:
+                _FORK_SHARED.inc((k - 1) * nshare)
+        if obs.TRACER.active:
+            # One instant per sibling — the fork-slot path's exact
+            # trace shape, so family post-mortems read identically
+            # whichever layout served them.
+            for b in range(1, k):
+                obs.instant("fork", cat="serving", args={
+                    "rid": req.uid, "tick": tick, "parent_slot": slot,
+                    "child_slot": slot, "index": b, "tree": True,
+                    "shared_blocks": nshare, "copied_blocks": 0,
+                    "at_tokens": 0,
+                })
+        for b, outcome in dead:
+            self._tree_finish_branch(slot, fam, b, outcome, tick, now2,
+                                     results)
+        self._tree_settle(slot, fam, 0, [], bool(dead), tick)
+        return emitted
+
+    def _pack_tree(
+        self, fam: _ForkFamily
+    ) -> Tuple[PackedSpec, List[int], int]:
+        """This tick's sibling bundle: every live branch's divergent
+        suffix (its tokens past the frozen ancestor rows), packed
+        branch-major. All live suffixes have equal length — each branch
+        gains exactly one token per tick. Returns (pack, the live
+        branch ids in packed order, the suffix length)."""
+        d = fam.fork_len - 1
+        order = [b for b in range(fam.branches) if fam.br_live[b]]
+        suffixes = [fam.br_tokens[b][d:] for b in order]
+        return pack_siblings(suffixes), order, len(suffixes[0])
+
+    def _tree_commit_all(
+        self,
+        tree_plan: Dict[int, Tuple[PackedSpec, List[int], int]],
+        alltok: np.ndarray,
+        alllp: np.ndarray,
+        now: float,
+        tick: int,
+        results: List[RequestResult],
+        tbt: List[float],
+    ) -> int:
+        """The host half of a tree-sibling tick: each live branch's next
+        token is the draw at its LAST packed row (rows before it
+        re-drew the branch's existing suffix tokens — same keys, same
+        logits, bit-identical, discarded). EOS/budget checks run per
+        branch; retires shrink the family the same tick (trim /
+        collapse / close). Returns tokens emitted."""
+        emitted_total = 0
+        for slot, (pack, order, s) in tree_plan.items():
+            fam = self._tree_fams.get(slot)
+            if fam is None:
+                continue
+            req = fam.req
+            self._tick_tree_branches += len(order)
+            self._tree_branches_life += len(order)
+            gap = max(now - self._last_tok_t[slot], 0.0)
+            self._last_tok_t[slot] = now
+            if gap > self._slot_max_tbt[slot]:
+                self._slot_max_tbt[slot] = gap
+            self.slo.observe_tbt(gap)
+            dead: List[Tuple[int, str]] = []
+            for rank, b in enumerate(order):
+                r = rank * s + s - 1
+                t_new = int(alltok[slot, r])
+                fam.br_tokens[b].append(t_new)
+                fam.br_cum_lp[b] += float(alllp[slot, r])
+                self._push_token(req, t_new, fam.br_index[b])
+                emitted_total += 1
+                tbt.append(gap if rank == 0 else 0.0)
+                if obs.REGISTRY.enabled:
+                    _TOKENS.inc()
+                    _TBT.observe(gap if rank == 0 else 0.0)
+                if req.eos_id is not None and t_new == req.eos_id:
+                    dead.append((b, OUTCOME_EOS))
+                elif len(fam.br_tokens[b]) >= req.max_new_tokens:
+                    dead.append((b, OUTCOME_BUDGET))
+            for b, outcome in dead:
+                self._tree_finish_branch(slot, fam, b, outcome, tick,
+                                         now, results)
+            self._tree_settle(slot, fam, s, order, bool(dead), tick)
+        return emitted_total
+
+    def _tree_finish_branch(self, slot: int, fam: _ForkFamily, b: int,
+                            outcome: str, tick: int, now: float,
+                            results) -> None:
+        """One tree branch leaves the family: its per-branch result is
+        final NOW (tokens, cum_logprob, its own outcome); the slot's
+        resources shrink in ``_tree_settle``, not here."""
+        fam.br_live[b] = False
+        req = fam.req
+        admit_tick, visible_at = self._slot_admit[slot]
+        res = RequestResult(
+            uid=req.uid,
+            tokens=list(fam.br_tokens[b]),
+            prompt_len=len(req.prompt),
+            arrival_tick=req.arrival_tick,
+            admit_tick=admit_tick,
+            finish_tick=tick,
+            queue_wait_s=self._slot_wait[slot],
+            completion_s=max(now - visible_at, 0.0),
+            outcome=outcome,
+            ttft_s=fam.br_ttft[b],
+            prefix_hit_tokens=self._slot_prefix_hit[slot],
+            index=fam.br_index[b],
+            cum_logprob=fam.br_cum_lp[b],
+        )
+        results.append(res)
+        if outcome in (OUTCOME_EOS, OUTCOME_BUDGET):
+            self.slo.observe_request(fam.br_ttft[b],
+                                     self._slot_max_tbt[slot])
+        elif outcome in (OUTCOME_DEADLINE, OUTCOME_SHED, OUTCOME_ERROR):
+            self.slo.observe_miss()
+        self._tick_branch_retired += 1
+        if obs.REGISTRY.enabled:
+            _REQUESTS.labels(outcome=outcome).inc()
+        if obs.TRACER.active:
+            obs.instant("request_retired", cat="serving", args={
+                "rid": req.uid, "slot": slot, "tick": tick,
+                "outcome": outcome, "index": fam.br_index[b],
+                "tree": True,
+            })
+        self._notify_finish(req, res, fam)
+        self._family_branch_done(fam, res)
+
+    def _tree_settle(self, slot: int, fam: _ForkFamily, s: int,
+                     order: List[int], retired_any: bool,
+                     tick: int) -> None:
+        """Normalize the slot after a tree tick (or the family start):
+        k live branches keep the tree (trimming the window reservation
+        when some retired — the same-tick no-leak contract), one
+        survivor collapses the slot back to plain decode, zero closes
+        it."""
+        k_live = sum(fam.br_live)
+        if k_live == 0:
+            self._tree_close(slot, fam, tick)
+        elif k_live == 1:
+            self._tree_collapse(slot, fam, s, order)
+        elif retired_any:
+            span = max(
+                fam.base_len
+                + k_live * (fam.req.max_new_tokens - fam.fork_len),
+                len(fam.req.prompt) + fam.req.max_new_tokens,
+            )
+            self._slot_trim(slot, -(-span // self.kv_block))
+
+    def _slot_trim(self, slot: int, need: int) -> None:
+        """Shrink ``slot`` to a ``need``-block worst case the SAME tick
+        its occupant got smaller: unmap private tail blocks past the
+        need (their rows belonged to retired branches; host bookkeeping
+        only — any in-flight gather already dispatched against the old
+        table) and return the excess reservation to the pool."""
+        if not self._paged:
+            return
+        while self._slot_nblocks[slot] > need:
+            j = self._slot_nblocks[slot] - 1
+            bid = int(self._host_table[slot, j])
+            if bid not in self._slot_private[slot]:
+                break  # shared ancestors never sit past the need
+            self._pool.unmap_private(bid)
+            self._slot_private[slot].discard(bid)
+            self._slot_reserve[slot] += 1
+            self._host_table[slot, j] = 0
+            self._slot_nblocks[slot] -= 1
+            self._table_dirty = True
+        excess = self._slot_nblocks[slot] + self._slot_reserve[slot] \
+            - need
+        if excess > 0:
+            give = min(excess, self._slot_reserve[slot])
+            if give:
+                self._pool.unreserve(give)
+                self._slot_reserve[slot] -= give
+                self._pool.gen += 1
+
+    def _tree_collapse(self, slot: int, fam: _ForkFamily, s: int,
+                       order: List[int]) -> None:
+        """One branch left: gather its replayed suffix contiguous
+        (compact_decode_window — a no-op when it already sits at rank
+        0), rebind the slot's mirrors and PRNG key to the survivor's
+        stream, park its tip, and hand the slot back to the plain
+        decode path. The survivor continues bit-identically: its slot
+        key chain equals the in-program fold it decoded under."""
+        req = fam.req
+        b = fam.br_live.index(True)
+        if s > 0:
+            rank = order.index(b)
+            if rank > 0:
+                w = max(self._spec_rows_cap, 1)
+                src = np.tile(np.arange(w, dtype=np.int32),
+                              (self.slots, 1))
+                src[slot, :s] = rank * s + np.arange(s, dtype=np.int32)
+                n = np.zeros((self.slots,), np.int32)
+                n[slot] = s
+                start = np.zeros((self.slots,), np.int32)
+                start[slot] = fam.base_len
+                self.cache = self._compact(
+                    self.cache, jnp.asarray(start), jnp.asarray(src),
+                    jnp.asarray(n),
+                )
+        self._slot_clen[slot] = fam.base_len + s
+        self._live_reset[slot] = fam.base_len + s
+        self._slot_tokens[slot] = fam.br_tokens[b]
+        self._slot_index[slot] = fam.br_index[b]
+        self._slot_cum_lp[slot] = fam.br_cum_lp[b]
+        self._slot_ttft[slot] = fam.br_ttft[b]
+        self._keys = self._seed_key(self._keys, jnp.int32(slot),
+                                    jnp.int32(self._salt_np[slot]),
+                                    jnp.int32(fam.br_index[b]))
+        tip = int(fam.br_tokens[b][-1])
+        self.cache, self.tok = self._fork_copy(
+            self.cache, self.tok, jnp.int32(0), jnp.int32(0),
+            jnp.int32(slot), jnp.int32(tip),
+        )
+        th = np.array(self._tok_host)
+        th[slot] = tip
+        self._tok_host = th
+        self._tree_fams.pop(slot, None)
+        need = -(-(len(req.prompt) + req.max_new_tokens)
+                 // self.kv_block)
+        self._slot_trim(slot, need)
+        if obs.TRACER.active:
+            obs.instant("tree_collapse", cat="serving", args={
+                "rid": req.uid, "slot": slot, "index": fam.br_index[b],
+                "suffix": s,
+            })
+
+    def _tree_close(self, slot: int, fam: _ForkFamily,
+                    tick: int) -> None:
+        """Every branch finished: close the request's span/ledger and
+        free the slot — prefix pins, private blocks, CoW refs, unspent
+        reservation — the same tick the last branch retired."""
+        self._tree_fams.pop(slot, None)
+        req = fam.req
+        span = self._slot_span[slot]
+        if span is not None:
+            if obs.TRACER.active:
+                span.set(
+                    tokens=sum(len(t) for t in fam.br_tokens),
+                    branches=fam.branches, tree=True,
+                )
+            span.__exit__(None, None, None)
+            self._slot_span[slot] = None
+        if obs.REQLOG.enabled:
+            led = obs.REQLOG.finish(
+                req.uid, outcome=fam.done[-1].outcome if fam.done
+                else OUTCOME_EOS, finish_tick=tick,
+                tokens_decoded=sum(len(t) for t in fam.br_tokens),
+                now=time.monotonic(),
+            )
+            if fam.done:
+                fam.done[-1].ledger = led
+        self._free_slot_resources(slot)
+        if not any(rq is not None and rq.uid == req.uid
+                   for rq in self._slot_req):
+            self._uid_next_index.pop(req.uid, None)
+
+    def _tree_convert_live(self, parent: int, uid: int,
+                           tick: int) -> str:
+        """Mid-generation fork(uid) as a tree conversion: keep the live
+        slot, freeze its committed rows as the shared ancestors, and
+        decode both continuations as a 2-branch token tree — zero new
+        slots, zero copied blocks (even the partial tail block is
+        shared; both branches re-consume the tip as replayed suffix
+        rows). Pure host bookkeeping plus the reservation delta."""
+        req = self._slot_req[parent]
+        toks = self._slot_tokens[parent]
+        t = len(toks)
+        plen = len(self._prompt_np[parent])
+        base_len = plen + t - 1
+        span = max(base_len + 2 * (req.max_new_tokens - t),
+                   plen + req.max_new_tokens)
+        need = -(-span // self.kv_block)
+        held = self._slot_nblocks[parent] + self._slot_reserve[parent]
+        delta = need - held
+        if delta > 0:
+            if not self._pool.reserve(delta):
+                return "retry"
+            self._slot_reserve[parent] += delta
+        idx = self._uid_next_index.get(uid, self._branches(req))
+        self._uid_next_index[uid] = idx + 1
+        fam = _ForkFamily(
+            req=req, parent_slot=parent, sibling_slots=[],
+            sib_reserve=0, hold=0, best_of=False, branches=2,
+            forked=True, tree=True, base_len=base_len, fork_len=t,
+            br_tokens=[toks, list(toks)],
+            br_cum_lp=[self._slot_cum_lp[parent],
+                       self._slot_cum_lp[parent]],
+            br_live=[True, True],
+            br_index=[self._slot_index[parent], idx],
+            br_ttft=[self._slot_ttft[parent], self._slot_ttft[parent]],
+        )
+        self._families[req.uid] = fam
+        self._tree_fams[parent] = fam
+        self._tree_fams_life += 1
+        # Tree ticks reset the device length to base_len every dispatch;
+        # a pending fork/collapse reset is subsumed.
+        self._live_reset.pop(parent, None)
+        self._slot_clen[parent] = base_len
+        nshare = base_len // self.kv_block
+        self._forks_life += 1
+        self._fork_shared_life += nshare
+        self._tick_forks += 1
+        self._tick_fork_shared += nshare
+        if obs.REGISTRY.enabled:
+            _FORKS.inc()
+            if nshare:
+                _FORK_SHARED.inc(nshare)
+        if obs.TRACER.active:
+            obs.instant("fork", cat="serving", args={
+                "rid": req.uid, "tick": tick, "parent_slot": parent,
+                "child_slot": parent, "index": idx, "tree": True,
+                "shared_blocks": nshare, "copied_blocks": 0,
+                "at_tokens": t,
+            })
+        if obs.REQLOG.enabled and nshare:
+            obs.REQLOG.note(req.uid, fork_shared_blocks=nshare)
+        return "done"
 
     # -- speculation (ISSUE 8) --------------------------------------------
 
@@ -2943,6 +3557,7 @@ class SlotServer:
         self,
         spec_plan: Dict[int, PackedSpec],
         alltok: np.ndarray,
+        alllp: np.ndarray,
         width: int,
         now: float,
         tick: int,
@@ -2950,12 +3565,20 @@ class SlotServer:
         tbt: List[float],
     ) -> int:
         """The host half of a verify tick: walk each slot's fetched
-        per-row argmaxes, emit the committed burst (EOS/budget checks in
+        per-row draws, emit the committed burst (EOS/budget checks in
         stream order — an EOS inside the burst truncates it, same tick),
         update the committed-length ledger (the next step's reset performs
         the device rollback), batch the tree compactions into ONE
         dispatch, and unmap rolled-back paged blocks. Returns the number
-        of tokens emitted."""
+        of tokens emitted.
+
+        Greedy slots walk the argmax path; sampled slots walk the
+        STOCHASTIC path (Leviathan coupling, arXiv:2211.17192): each
+        window row's fetched token was drawn from the target softmax
+        under that row's deterministic stream key, so accepting a draft
+        token iff the draw equals it emits exactly the target
+        distribution — token-identical to non-speculative sampling
+        under the same seed."""
         emitted_total = 0
         compact_src: Optional[np.ndarray] = None
         compact_n: Optional[np.ndarray] = None
@@ -2964,7 +3587,12 @@ class SlotServer:
         t_ver = 0
         for i, pack in spec_plan.items():
             req = self._slot_req[i]
-            kept, committed = accept_longest_path(pack, alltok[i])
+            if self._temp_np[i] > 0.0:
+                kept, committed = accept_stochastic_path(pack, alltok[i])
+                if obs.REGISTRY.enabled and pack.rows:
+                    _SPEC_ACCEPT_SAMPLES.inc(pack.rows)
+            else:
+                kept, committed = accept_longest_path(pack, alltok[i])
             m = pack.rows - 1
             t_slots += 1
             t_prop += m
@@ -2997,8 +3625,10 @@ class SlotServer:
                 self._slot_max_tbt[i] = gap
             self.slo.observe_tbt(gap)
             hl = self._hist_len[i]
+            rows_used = [0] + kept  # window row each committed token used
             for j, t in enumerate(emit_list):
                 self._slot_tokens[i].append(int(t))
+                self._slot_cum_lp[i] += float(alllp[i][rows_used[j]])
                 self._hist_buf[i, hl + j] = int(t)
                 self._push_token(req, int(t))
                 tbt.append(gap if j == 0 else 0.0)
@@ -3134,6 +3764,57 @@ class SlotServer:
                 reset, reset_val,
             )
 
+    def _free_slot_resources(self, slot: int) -> None:
+        """Release everything a slot holds — prefix pins, private paged
+        blocks, CoW refs, unspent reservation — and mark it free.
+        Shared exit arc of ``_retire`` and ``_tree_close``."""
+        self._slot_req[slot] = None
+        self._slot_tokens[slot] = []
+        self._slot_state[slot] = "free"
+        self._prompt_np[slot] = None
+        self._slot_logits[slot] = None
+        if self._prefix is not None and self._slot_nodes[slot]:
+            # The request's pinned prefix path becomes evictable.
+            self._prefix.release(self._slot_nodes[slot])
+            self._slot_nodes[slot] = []
+        if self._paged:
+            # Blocks the tree adopted stay cached (pins just dropped);
+            # the slot's remaining private blocks — decode tail, partial
+            # prompt block, unpublished spans — go back to the free list,
+            # along with any unspent worst-case reservation (early EOS).
+            for bid in self._slot_private[slot]:
+                self._pool.free_private(bid)
+            self._slot_private[slot] = set()
+            # CoW-shared fork ancestors (ISSUE 15): this owner's
+            # refcount drops on EVERY exit arc; the last branch's
+            # release frees the block.
+            for bid in self._slot_shared[slot]:
+                self._pool.release_shared(bid)
+            self._slot_shared[slot] = set()
+            self._live_reset.pop(slot, None)
+            if self._slot_reserve[slot]:
+                self._pool.unreserve(self._slot_reserve[slot])
+                self._slot_reserve[slot] = 0
+            self._host_table[slot, :] = 0  # stale ids must never be read
+            self._slot_nblocks[slot] = 0
+            self._table_dirty = True
+            # The pin releases above can grow EVICTABILITY without
+            # touching the free list — clear the admit loop's deferral
+            # latch so the queue head retries.
+            self._pool.gen += 1
+
+    def _tree_retire_all(self, slot: int, fam: _ForkFamily, tick: int,
+                         outcome: str,
+                         results: List[RequestResult]) -> None:
+        """Cancel/deadline/shed a started tree family: every live
+        branch finishes with the slot's outcome, then the slot closes."""
+        now = time.monotonic()
+        for b in range(fam.branches):
+            if fam.br_live[b]:
+                self._tree_finish_branch(slot, fam, b, outcome, tick,
+                                         now, results)
+        self._tree_close(slot, fam, tick)
+
     def _retire(self, slot: int, tick: int, outcome: str,
                 results: List[RequestResult]) -> None:
         """Free a slot on ANY outcome arc. The happy paths (eos/budget)
@@ -3142,6 +3823,16 @@ class SlotServer:
         reservations — so retiring a request mid-prefill or mid-stream
         is just this, earlier (cancellation is cheap by construction:
         PagedAttention's unmap, arXiv:2309.06180)."""
+        tfam = self._tree_fams.get(slot)
+        if tfam is not None:
+            if tfam.forked:
+                # Started tree family: per-branch results, shared close.
+                self._tree_retire_all(slot, tfam, tick, outcome, results)
+                return
+            # Unstarted (still prefilling / awaiting first token): the
+            # plain retire below settles it — _cancel_unforked's tree
+            # arm synthesizes the sibling results.
+            self._tree_fams.pop(slot)
         req = self._slot_req[slot]
         admit_tick, visible_at = self._slot_admit[slot]
         now = time.monotonic()
@@ -3198,40 +3889,7 @@ class SlotServer:
                 req.uid, outcome=outcome, finish_tick=tick,
                 tokens_decoded=len(result.tokens), now=now,
             )
-        self._slot_req[slot] = None
-        self._slot_tokens[slot] = []
-        self._slot_state[slot] = "free"
-        self._prompt_np[slot] = None
-        self._slot_logits[slot] = None
-        if self._prefix is not None and self._slot_nodes[slot]:
-            # The request's pinned prefix path becomes evictable.
-            self._prefix.release(self._slot_nodes[slot])
-            self._slot_nodes[slot] = []
-        if self._paged:
-            # Blocks the tree adopted stay cached (pins just dropped);
-            # the slot's remaining private blocks — decode tail, partial
-            # prompt block, unpublished spans — go back to the free list,
-            # along with any unspent worst-case reservation (early EOS).
-            for bid in self._slot_private[slot]:
-                self._pool.free_private(bid)
-            self._slot_private[slot] = set()
-            # CoW-shared fork ancestors (ISSUE 15): this owner's
-            # refcount drops on EVERY exit arc; the last branch's
-            # release frees the block.
-            for bid in self._slot_shared[slot]:
-                self._pool.release_shared(bid)
-            self._slot_shared[slot] = set()
-            self._live_reset.pop(slot, None)
-            if self._slot_reserve[slot]:
-                self._pool.unreserve(self._slot_reserve[slot])
-                self._slot_reserve[slot] = 0
-            self._host_table[slot, :] = 0  # stale ids must never be read
-            self._slot_nblocks[slot] = 0
-            self._table_dirty = True
-            # The pin releases above can grow EVICTABILITY without
-            # touching the free list — clear the admit loop's deferral
-            # latch so the queue head retries.
-            self._pool.gen += 1
+        self._free_slot_resources(slot)
         if obs.REGISTRY.enabled:
             _REQUESTS.labels(outcome=outcome).inc()
         # Fork-family join bookkeeping (ISSUE 15): a parent retiring
@@ -3295,6 +3953,7 @@ class SlotServer:
         spec0 = (self._spec_proposed, self._spec_accepted,
                  self._spec_ticks, self._spec_verifies)
         fork0 = (self._forks_life, self._fork_shared_life)
+        tree0 = (self._tree_fams_life, self._tree_branches_life)
         if self._paged:
             self._peak_blocks_used = self._pool.used
             self._defer_gen = -1  # stale latch must not defer a fresh run
@@ -3319,6 +3978,8 @@ class SlotServer:
                 self._tick_shed = 0
                 self._tick_forks = 0
                 self._tick_fork_shared = 0
+                self._tick_tree_branches = 0
+                self._tick_branch_retired = 0
 
                 # Ingest newly visible requests. A live source's invalid
                 # request must not kill the loop serving everyone else —
@@ -3446,7 +4107,11 @@ class SlotServer:
                     # so two half-admitted families can never deadlock
                     # each other's slots.
                     branches = self._branches(pending[0])
-                    if branches > len(free):
+                    # A tree-sibling family (ISSUE 20) needs ONE slot
+                    # however many branches it decodes.
+                    tree_adm = (branches > 1
+                                and self._tree_sibling_ok(pending[0]))
+                    if (1 if tree_adm else branches) > len(free):
                         break
                     resv = None
                     if self._paged:
@@ -3473,7 +4138,10 @@ class SlotServer:
                         # The family exists BEFORE the admission runs:
                         # whole-admission prefill stashes the family's
                         # prompt-end logits synchronously inside _admit.
-                        self._admit_family(req, slot, free, resv)
+                        if tree_adm:
+                            self._admit_tree_family(req, slot)
+                        else:
+                            self._admit_family(req, slot, free, resv)
                     self._admit(req, slot, tick, vis, resv)
                 queue_depth = len(pending)  # visible but still unadmitted
 
@@ -3522,8 +4190,12 @@ class SlotServer:
                     continue
                     # lint: mirror[idle] end
 
-                # Plan this tick's prefill chunks (chunked admission only).
-                plan = (self._plan_chunks()
+                # Plan this tick's prefill chunks (chunked admission
+                # only). While a tree family decodes, chunks clamp to
+                # the int32 tree-bitmask width — the sibling bundle
+                # must never be forced onto a Tq > 32 program.
+                plan = (self._plan_chunks(
+                            max_n=32 if self._tree_fams else None)
                         if self.admission == "chunked" else [])
                 chunk_tokens = sum(n for _, n, _ in plan)
                 # The staged path rebinds ``plan`` to []; keep the tick's
@@ -3534,6 +4206,10 @@ class SlotServer:
                             if st == "live"]
                 if obs.REGISTRY.enabled:
                     _SLOTS_OCCUPIED.set(len(live_idx))
+                    _TREE_BRANCHES.set(sum(
+                        sum(f.br_live) for f in self._tree_fams.values()
+                        if f.forked
+                    ))
 
                 # The per-tick mixed-step span: occupancy, chunk-budget
                 # spent, and queue depth tagged on the one program the
@@ -3557,9 +4233,21 @@ class SlotServer:
 
                     stepped = False
                     spec_plan: Dict[int, PackedSpec] = {}
+                    tree_plan: Dict[
+                        int, Tuple[PackedSpec, List[int], int]
+                    ] = {}
                     all_tok_dev = None
                     fused_dev = None
                     spec_width = 0
+                    if self._tree_fams:
+                        # Token-tree sibling decode (ISSUE 20): every
+                        # started family's live suffixes pack into one
+                        # verify-shaped bundle for its ONE slot. Packing
+                        # is pure host work, same as drafting.
+                        for i, tfam in self._tree_fams.items():
+                            if tfam.forked \
+                                    and self._slot_state[i] == "live":
+                                tree_plan[i] = self._pack_tree(tfam)
                     if self._speculate and live_idx:
                         # Draft-and-verify (ISSUE 8): every live slot's
                         # tick becomes a verify chunk — tip token at row
@@ -3576,14 +4264,18 @@ class SlotServer:
                             spec_plan[i] = self._draft_slot(
                                 i, tree_ok=chunk_tq <= 32
                             )
-                    if self._speculate and (plan or spec_plan):
-                        # THE verify tick: decode-verify rows and prefill
-                        # chunks share one compiled program, exactly like
-                        # the mixed tick — greedy row argmaxes ride back
-                        # as a second output for the accept walk.
-                        rows_max = max(
-                            [p.rows for p in spec_plan.values()] or [1]
-                        )
+                    if (self._speculate and (plan or spec_plan)) \
+                            or tree_plan:
+                        # THE verify tick: decode-verify rows (draft
+                        # windows under speculation, sibling bundles
+                        # under tree decode) and prefill chunks share
+                        # one compiled program, exactly like the mixed
+                        # tick — per-row draws ride back as a fused
+                        # output for the accept walk / branch tips.
+                        rows_all = [p.rows for p in spec_plan.values()]
+                        rows_all += [pk.rows
+                                     for pk, _, _ in tree_plan.values()]
+                        rows_max = max(rows_all or [1])
                         # Draft-less ticks (nothing proposed anywhere)
                         # run the Tq=1 shape — low-acceptance traffic
                         # must not pay the padded verify bucket for
@@ -3610,7 +4302,21 @@ class SlotServer:
                         use_dev0 = np.asarray(
                             [st == "await" for st in self._slot_state]
                         )
-                        need_tree = False
+                        sidx = np.asarray(
+                            [len(t) for t in self._slot_tokens], np.int32
+                        )
+                        # Per-ROW key-chain operands (ISSUE 20): the
+                        # defaults put every row on the slot's own spec
+                        # chain — branch < 0 folds fold_in(slot_key,
+                        # stream index); sibling rows overwrite both
+                        # with the fork-slot chain's (branch, index).
+                        branch_m = np.full((self.slots, tq), -1,
+                                           np.int32)
+                        ridx_m = sidx[:, None] + np.tile(
+                            np.arange(tq, dtype=np.int32),
+                            (self.slots, 1),
+                        )
+                        need_tree = bool(tree_plan)
                         for i, pack in spec_plan.items():
                             r = pack.rows
                             self._ensure_blocks(i, self._slot_clen[i] + r)
@@ -3621,10 +4327,48 @@ class SlotServer:
                             # rows until this reset.
                             reset[i] = True
                             reset_val[i] = self._slot_clen[i]
+                            ridx_m[i, :r] = sidx[i] + pack.depth
                             if not np.array_equal(
                                 pack.depth, np.arange(r, dtype=np.int32)
                             ):
                                 need_tree = True
+                        for i, (pack, order, s) in tree_plan.items():
+                            tfam = self._tree_fams[i]
+                            r = pack.rows
+                            self._ensure_blocks(i, tfam.base_len + r)
+                            mat[i, :r] = pack.row_tokens
+                            n_vec[i] = r
+                            # The replay reset: committed rows freeze at
+                            # the shared ancestors; every suffix row is
+                            # re-derived into the window PAST them.
+                            reset[i] = True
+                            reset_val[i] = tfam.base_len
+                            branch_m[i, :r] = np.repeat(np.asarray(
+                                [tfam.br_index[b] for b in order],
+                                np.int32,
+                            ), s)
+                            ridx_m[i, :r] = tfam.fork_len + pack.depth
+                        if not self._speculate:
+                            # Plain live slots ride the tree tick as
+                            # n=1 decode rows (the mixed-step contract),
+                            # including a forked child's one pending
+                            # length reset.
+                            for i in live_idx:
+                                if i in tree_plan:
+                                    continue
+                                self._ensure_blocks(
+                                    i, len(self._slot_req[i].prompt)
+                                    + len(self._slot_tokens[i])
+                                )
+                                mat[i, 0] = self._tok_host[i]
+                                n_vec[i] = 1
+                                emit[i] = True
+                            for i in list(self._live_reset):
+                                if self._slot_state[i] == "live" \
+                                        and i not in tree_plan:
+                                    reset[i] = True
+                                    reset_val[i] = \
+                                        self._live_reset.pop(i)
                         for slot, n, last in plan:
                             self._ensure_blocks(
                                 slot, self._prefill_pos[slot] + n
@@ -3643,6 +4387,13 @@ class SlotServer:
                             jnp.asarray(reset), jnp.asarray(reset_val),
                             jnp.asarray(emit),
                         )
+                        extra = (
+                            self._keys, jnp.asarray(self._temp_np),
+                            jnp.asarray(self._topk_np),
+                            jnp.asarray(sidx), self._lp,
+                            jnp.asarray(self._salt_np),
+                            jnp.asarray(branch_m), jnp.asarray(ridx_m),
+                        )
                         if need_tree:
                             # Per-slot depths + ancestor bitmasks; chain
                             # slots (and prefill chunks) ride the arange/
@@ -3660,16 +4411,29 @@ class SlotServer:
                                 r = pack.rows
                                 depth_m[i, :r] = pack.depth
                                 bits_m[i, :r, :r] = pack.anc
-                            all_tok_dev, self.cache = self._spec_tree(
-                                *args, jnp.asarray(depth_m),
-                                jnp.asarray(bits_m), self.cache,
-                            )
+                            for i, (pack, _, _) in tree_plan.items():
+                                r = pack.rows
+                                depth_m[i, :r] = pack.depth
+                                bits_m[i, :r, :r] = pack.anc
+                            self.tok, self._lp, all_tok_dev, last_dev, \
+                                self.cache = self._spec_tree(
+                                    *args, jnp.asarray(depth_m),
+                                    jnp.asarray(bits_m), self.cache,
+                                    *extra,
+                                )
                         else:
-                            all_tok_dev, self.cache = self._spec_lin(
-                                *args, self.cache
-                            )
-                        self.tok = all_tok_dev[:, 0]
+                            self.tok, self._lp, all_tok_dev, last_dev, \
+                                self.cache = self._spec_lin(
+                                    *args, self.cache, *extra,
+                                )
                         stepped = True
+                        for slot, n, last in plan:
+                            # Stash prompt-end logits for slots whose
+                            # fork/tree family expands at this tick's
+                            # awaits pass (ISSUE 15/20).
+                            if last and self._slot_req[slot].uid \
+                                    in self._families:
+                                self._slot_logits[slot] = last_dev[slot]
                         if self._prefix is not None:
                             for slot, n, last in plan:
                                 if last:
@@ -3786,6 +4550,7 @@ class SlotServer:
                     host_sync = bool(awaits or live_idx)
                     tokens_this_tick = 0
                     alltok_host = None
+                    alllp_host = None
                     if host_sync:
                         # THE per-tick host sync: every new token of this
                         # tick — decode samples, fused final-chunk first
@@ -3801,10 +4566,17 @@ class SlotServer:
                         # vector AND every row argmax in the same sync.
                         lp_valid = False
                         if all_tok_dev is not None:
-                            # lint: allow[host-sync] THE one per-tick fetch (verify ticks: fused token vector + row argmaxes)
+                            # lint: allow[host-sync] THE one per-tick fetch (verify ticks: token/logprob vectors + every row draw, one fused array)
                             fused_host = np.asarray(all_tok_dev)
-                            self._tok_host = fused_host[:, 0]
-                            alltok_host = fused_host[:, 1:]
+                            self._tok_host = fused_host[:, 0, 0]
+                            self._lp_host = np.ascontiguousarray(
+                                fused_host[:, 0, 1]
+                            ).view(np.float32)
+                            alltok_host = fused_host[:, 1:, 0]
+                            alllp_host = np.ascontiguousarray(
+                                fused_host[:, 1:, 1]
+                            ).view(np.float32)
+                            lp_valid = True
                         elif fused_dev is not None:
                             # lint: allow[host-sync] THE one per-tick fetch (token vector + bitcast logprobs, one fused array)
                             fh = np.asarray(fused_dev)
@@ -3873,6 +4645,19 @@ class SlotServer:
                             fam = self._families.get(req.uid)
                             if fam is not None and not fam.forked \
                                     and i == fam.parent_slot:
+                                if fam.tree:
+                                    # Tree-sibling start (ISSUE 20):
+                                    # every branch's first token —
+                                    # branch 0's EOS/budget included —
+                                    # is handled inside, so the generic
+                                    # checks below must not run.
+                                    n_new = self._tree_family_start(
+                                        fam, i, first, tick, now2,
+                                        results,
+                                    )
+                                    tokens += n_new
+                                    tokens_this_tick += n_new
+                                    continue
                                 n_new = self._fork_family(
                                     fam, i, tick, now2, results
                                 )
@@ -3886,17 +4671,29 @@ class SlotServer:
                                              results)
                         if self._speculate:
                             # Spec mode: live-slot tokens come from the
-                            # verify walk over the fetched row argmaxes,
+                            # verify walk over the fetched row draws,
                             # 1..draft_k+1 of them per slot per tick.
                             if spec_plan:
                                 n_new = self._spec_commit_all(
-                                    spec_plan, alltok_host,
+                                    spec_plan, alltok_host, alllp_host,
                                     spec_width, now2, tick, results, tbt,
                                 )
                                 tokens += n_new
                                 tokens_this_tick += n_new
                         else:
+                            if tree_plan:
+                                # Tree mode: each live branch's token is
+                                # its last packed row's draw; retires
+                                # shrink the family the same tick.
+                                n_new = self._tree_commit_all(
+                                    tree_plan, alltok_host, alllp_host,
+                                    now2, tick, results, tbt,
+                                )
+                                tokens += n_new
+                                tokens_this_tick += n_new
                             for i in live_idx:
+                                if i in tree_plan:
+                                    continue
                                 req = self._slot_req[i]
                                 tok_i = int(self._tok_host[i])
                                 # Every live slot enters this loop with
@@ -3987,6 +4784,11 @@ class SlotServer:
                         # copying.
                         "forks": self._tick_forks,
                         "shared_blocks": self._tick_fork_shared,
+                        # Token-tree sibling decode this tick (ISSUE
+                        # 20): branches advanced in-slot, branches
+                        # retired out of their bundles.
+                        "tree_branches": self._tick_tree_branches,
+                        "branch_retired": self._tick_branch_retired,
                         "draining": draining,
                     }
                     if self._paged:
@@ -4046,6 +4848,11 @@ class SlotServer:
             # Drained, not wedged: /healthz stays 200 "idle" between runs
             # however long this run's last tick ages.
             FLIGHT.mark_idle()
+        if obs.REGISTRY.enabled:
+            # The branch gauge is set at tick TOP, so a drained run would
+            # otherwise freeze it at the last mid-run value; every family
+            # closed, so the truth between runs is zero.
+            _TREE_BRANCHES.set(0)
         with self._ctl_lock:
             # This run consumed its control state; the engine is reusable
             # (a drain that completed must not auto-drain the next run).
@@ -4093,6 +4900,14 @@ class SlotServer:
                 kv_snap["forks"] = self._forks_life - fork0[0]
                 kv_snap["fork_blocks_shared"] = (
                     self._fork_shared_life - fork0[1]
+                )
+            if self._tree_fams_life - tree0[0]:
+                # Token-tree sibling accounting for THIS run (ISSUE 20).
+                kv_snap["tree_families"] = (
+                    self._tree_fams_life - tree0[0]
+                )
+                kv_snap["tree_branch_ticks"] = (
+                    self._tree_branches_life - tree0[1]
                 )
             if self._host_pool is not None:
                 h1 = self._host_pool.stats()

@@ -183,6 +183,86 @@ def accept_longest_path(
         cur = child
 
 
+def accept_stochastic_path(
+    pack: PackedSpec, row_sample: Sequence[int]
+) -> Tuple[List[int], List[int]]:
+    """The STOCHASTIC accept rule (ISSUE 20) — Leviathan et al.'s
+    speculative-sampling ratio test (arXiv:2211.17192) specialized to
+    point-mass drafters, which is what every drafter here proposes.
+
+    ``row_sample[j]`` is a draw from the TARGET model's (temperature /
+    top-k adjusted) distribution after consuming the path ending at row
+    ``j`` (``models.decode.sample_rows`` under the request's
+    ``fold_in(key, stream_index)`` chain). For a draft that proposes
+    token ``x`` with probability 1, the ratio test accepts with
+    probability ``p(x)`` and otherwise emits a sample from the residual
+    ``(p - q)+ / Z`` — which for a point mass at ``x`` is exactly
+    ``p`` restricted to ``y != x``. Drawing ``s ~ p`` once and
+    accepting iff ``s == x`` (else emitting ``s``) realizes both cases
+    with the correct joint law, so each committed token is distributed
+    EXACTLY as non-speculative sampling — and, because the draw's key
+    is a pure function of the request key and the token's stream index,
+    the committed stream is bit-identical to the non-speculative
+    sampled stream under the same seed.
+
+    Multi-child tree nodes chain the same test over the packed
+    children; the marginal emission law is unchanged (each rejected
+    point mass removes only the mass the next test renormalizes over).
+
+    The walk is therefore the SAME walk as the greedy rule with samples
+    in place of argmaxes — this wrapper exists to carry the contract.
+    """
+    return accept_longest_path(pack, row_sample)
+
+
+def pack_siblings(suffixes: Sequence[Sequence[int]]) -> PackedSpec:
+    """Pack k sibling branches' divergent suffixes into ONE verify-shaped
+    row bundle (ISSUE 20, token-tree sibling decode; SpecInfer's tree
+    pointed at futures, arXiv:2305.09781).
+
+    Every live branch must carry an EQUAL-length suffix (each gains
+    exactly one token per tick, so this is an invariant, asserted):
+    branch ``r``'s suffix occupies rows ``[r*s, (r+1)*s)`` in branch
+    order, ``depth[r*s + j] = j`` (its RoPE offset below the frozen
+    fork-point length), and the ancestor mask is per-branch
+    lower-triangular — rows NEVER see another branch's rows, which is
+    what lets k divergent futures share one slot's committed history.
+
+    The bundle must fit the attention kernels' int32 bitmask packing:
+    ``rows <= 32`` (the same Tq contract the pallas decode kernel
+    enforces); the engine's admission fit gate guarantees it, and the
+    assert here is the packer's own last line of defense.
+    """
+    k = len(suffixes)
+    if k < 1:
+        raise ValueError("pack_siblings needs >= 1 live branch")
+    s = len(suffixes[0])
+    if any(len(sx) != s for sx in suffixes):
+        raise ValueError(
+            f"sibling suffixes must be equal length, got "
+            f"{[len(sx) for sx in suffixes]}"
+        )
+    rows = k * s
+    assert rows <= 32, (
+        f"sibling bundle of {k} branches x {s} tokens = {rows} rows "
+        f"exceeds the 32-row tree-mask contract (admission fit gate "
+        f"should have forced the fork-slot path)"
+    )
+    row_tokens = np.empty((rows,), np.int32)
+    row_parents = np.empty((rows,), np.int32)
+    depth = np.empty((rows,), np.int32)
+    anc = np.zeros((rows, rows), bool)
+    for r in range(k):
+        o = r * s
+        row_tokens[o:o + s] = np.asarray(suffixes[r], np.int32)
+        depth[o:o + s] = np.arange(s, dtype=np.int32)
+        row_parents[o] = -1
+        row_parents[o + 1:o + s] = np.arange(o, o + s - 1, dtype=np.int32)
+        anc[o:o + s, o:o + s] = np.tril(np.ones((s, s), bool))
+    return PackedSpec(row_tokens=row_tokens, row_parents=row_parents,
+                      depth=depth, anc=anc)
+
+
 # ---------------------------------------------------------------------------
 # Drafters
 # ---------------------------------------------------------------------------
